@@ -1,0 +1,23 @@
+"""bucketeer_tpu — a TPU-native TIFF -> JPEG 2000 -> S3 ingest framework.
+
+A ground-up re-design of UCLALibrary/jp2-bucketeer (Java 11 / Vert.x 3.9,
+see /root/reference). The reference outsources its only compute kernel —
+the JPEG 2000 encode — to the proprietary Kakadu ``kdu_compress`` C++
+binary (reference: converters/KakaduConverter.java:36); here that codec is
+implemented natively for TPU: color transforms, tiled 2-D DWT and
+quantization as jitted/vmapped XLA, EBCOT Tier-1 bit-plane coding with a
+Pallas kernel front-end and a multithreaded C++ MQ coder, and Tier-2
+codestream assembly on host.
+
+Package layout (SURVEY.md §7 build plan):
+
+- :mod:`bucketeer_tpu.codec`       — the JPEG 2000 encoder (the real work)
+- :mod:`bucketeer_tpu.converters`  — Converter SPI (TpuConverter, CliConverter)
+- :mod:`bucketeer_tpu.engine`      — Job/Item/JobFactory model + async job engine
+- :mod:`bucketeer_tpu.server`      — OpenAPI HTTP layer + web UI
+- :mod:`bucketeer_tpu.parallel`    — device mesh sharding, batch scheduler
+- :mod:`bucketeer_tpu.utils`       — path prefixes, message codes
+- ``bucketeer_tpu/native``         — C++ Tier-1/MQ coder (ctypes)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,33 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+The reference gates its hardware-dependent tests (Kakadu) behind runtime
+probes (reference: src/test/java/.../converters/KakaduConverterTest.java:97-115).
+We do the analog for TPUs: tests always run on a virtual 8-device CPU
+platform so sharding logic is exercised without real chips; real-TPU
+benchmarks live in bench.py.
+
+Note: this environment's sitecustomize registers a TPU PJRT plugin and
+sets ``jax_platforms`` via jax.config (which overrides the JAX_PLATFORMS
+env var), so we must write the config back — before any backend is
+initialized — rather than rely on the environment.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(20260729)

@@ -1,0 +1,94 @@
+"""DWT tests: perfect reconstruction, integer exactness, arbitrary sizes.
+
+Mirrors the reference's converter unit tier (SURVEY.md §4) but for the
+in-process codec: the reference could only assert on kdu_compress output
+size (KakaduConverterTest.java:106-107); we can assert transform math.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bucketeer_tpu.codec import dwt
+
+
+SIZES = [(64, 64), (63, 61), (1, 17), (16, 1), (33, 64), (512, 512)]
+
+
+@pytest.mark.parametrize("h,w", SIZES)
+def test_53_perfect_reconstruction(rng, h, w):
+    x = rng.integers(-(1 << 15), 1 << 15, size=(h, w)).astype(np.int32)
+    levels = 3 if min(h, w) >= 8 else 1
+    ll, bands = dwt.dwt2d_forward(jnp.asarray(x), levels, reversible=True)
+    out = dwt.dwt2d_inverse(ll, bands, reversible=True)
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+@pytest.mark.parametrize("h,w", SIZES)
+def test_97_perfect_reconstruction(rng, h, w):
+    x = (rng.random(size=(h, w)) * 255 - 128).astype(np.float32)
+    levels = 3 if min(h, w) >= 8 else 1
+    ll, bands = dwt.dwt2d_forward(jnp.asarray(x), levels, reversible=False)
+    out = dwt.dwt2d_inverse(ll, bands, reversible=False)
+    np.testing.assert_allclose(np.asarray(out), x, atol=2e-3)
+
+
+def test_53_six_levels_512(rng):
+    x = rng.integers(-128, 128, size=(512, 512)).astype(np.int32)
+    ll, bands = dwt.dwt2d_forward(jnp.asarray(x), 6, reversible=True)
+    assert ll.shape == (8, 8)
+    assert len(bands) == 6
+    assert bands[0]["HH"].shape == (256, 256)
+    out = dwt.dwt2d_inverse(ll, bands, reversible=True)
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_subband_shapes_match(rng):
+    h, w = 100, 73
+    x = rng.integers(-128, 128, size=(h, w)).astype(np.int32)
+    levels = 4
+    ll, bands = dwt.dwt2d_forward(jnp.asarray(x), levels, reversible=True)
+    (llh, llw), shapes = dwt.subband_shapes(h, w, levels)
+    assert ll.shape == (llh, llw)
+    for l in range(levels):
+        for name in ("HL", "LH", "HH"):
+            assert bands[l][name].shape == shapes[l][name], (l, name)
+
+
+def test_97_lowpass_dc_gain_is_one():
+    # Constant signal must appear (almost) unchanged in LL with zero bands.
+    x = jnp.full((64, 64), 77.0)
+    ll, bands = dwt.dwt2d_forward(x, 3, reversible=False)
+    np.testing.assert_allclose(np.asarray(ll), 77.0, rtol=1e-5)
+    for b in bands:
+        for name in ("HL", "LH", "HH"):
+            np.testing.assert_allclose(np.asarray(b[name]), 0.0, atol=1e-3)
+
+
+def test_batched_vmap_consistency(rng):
+    import jax
+    x = rng.integers(-128, 128, size=(4, 64, 64)).astype(np.int32)
+
+    def fwd(a):
+        ll, bands = dwt.dwt2d_forward(a, 2, reversible=True)
+        return ll, bands[0]["HH"]
+
+    ll_b, hh_b = jax.vmap(fwd)(jnp.asarray(x))
+    for i in range(4):
+        ll_i, bands_i = dwt.dwt2d_forward(jnp.asarray(x[i]), 2, reversible=True)
+        np.testing.assert_array_equal(np.asarray(ll_b[i]), np.asarray(ll_i))
+        np.testing.assert_array_equal(np.asarray(hh_b[i]), np.asarray(bands_i[0]["HH"]))
+
+
+def test_synthesis_gains_sane():
+    ll_gain, bands = dwt.synthesis_gains(5, reversible=False)
+    # Lowpass synthesis energy grows ~2x per level.
+    assert ll_gain > 1.0
+    for l in range(5):
+        # HL and LH are transposes of each other: identical gains.
+        assert abs(bands[l]["HL"] - bands[l]["LH"]) < 1e-6 * bands[l]["HL"]
+        assert bands[l]["HH"] > 0
+    # Finest-level HH norm: ~2.08 == 2 * the classic 1.04 (our highpass
+    # carries the Nyquist-gain-2 convention used for step-size signaling).
+    assert 1.8 < bands[0]["HH"] < 2.4
+    # Gains grow with level depth (coarser bands synthesize more energy).
+    assert bands[4]["HL"] > bands[0]["HL"]

@@ -1,0 +1,36 @@
+"""Color transform tests (analog of the reference's unit tier, SURVEY.md §4)."""
+import numpy as np
+import jax.numpy as jnp
+
+from bucketeer_tpu.codec import transforms as tr
+
+
+def test_rct_roundtrip_exact(rng):
+    rgb = rng.integers(0, 256, size=(64, 64, 3)).astype(np.int32)
+    shifted = tr.level_shift_forward(jnp.asarray(rgb), 8)
+    ycc = tr.rct_forward(shifted)
+    back = tr.rct_inverse(ycc)
+    out = tr.level_shift_inverse(back, 8)
+    np.testing.assert_array_equal(np.asarray(out), rgb)
+
+
+def test_rct_16bit_roundtrip(rng):
+    rgb = rng.integers(0, 1 << 16, size=(32, 32, 3)).astype(np.int32)
+    shifted = tr.level_shift_forward(jnp.asarray(rgb), 16)
+    out = tr.level_shift_inverse(tr.rct_inverse(tr.rct_forward(shifted)), 16)
+    np.testing.assert_array_equal(np.asarray(out), rgb)
+
+
+def test_ict_roundtrip_close(rng):
+    rgb = rng.random(size=(64, 64, 3)).astype(np.float32) * 255 - 128
+    ycc = tr.ict_forward(jnp.asarray(rgb))
+    back = tr.ict_inverse(ycc)
+    np.testing.assert_allclose(np.asarray(back), rgb, atol=1e-3)
+
+
+def test_ict_known_values():
+    # Pure gray maps to Y=gray, Cb=Cr=0.
+    gray = jnp.full((4, 4, 3), 100.0)
+    ycc = np.asarray(tr.ict_forward(gray))
+    np.testing.assert_allclose(ycc[..., 0], 100.0, atol=1e-4)
+    np.testing.assert_allclose(ycc[..., 1:], 0.0, atol=1e-4)
