@@ -29,10 +29,11 @@ BETA = -0.052980118572961
 GAMMA = 0.882911075530934
 DELTA = 0.443506852043971
 K = 1.230174104914001
-# Subband scaling: lowpass *= 1/K (DC gain 1), highpass *= K/2 (Nyquist
-# gain 2, matching the gain convention used for quantizer-step signaling).
+# Subband scaling (T.800 F.4.8.2): lowpass *= 1/K, highpass *= K.
+# Calibrated against the OpenJPEG inverse: this pairing reconstructs to
+# ~138 dB PSNR through opj's IDWT; other pairings lose 30-120 dB.
 K_LO = 1.0 / K
-K_HI = K / 2.0
+K_HI = K
 
 _PAD = 8  # covers the 4-step lifting support with margin
 
@@ -135,9 +136,12 @@ def dwt2d_forward(x: jnp.ndarray, levels: int, reversible: bool):
     ll = x
     bands = []
     for _ in range(levels):
-        h_lo, h_hi = fwd(ll)                       # horizontal
-        ll, lh = _along_rows(fwd, h_lo)            # vertical on lowpass
-        hl, hh = _along_rows(fwd, h_hi)            # vertical on highpass
+        # Vertical split first, then horizontal (T.800 F.4.2 ordering —
+        # matters for the rounded 5/3 lifting; the inverse undoes
+        # horizontal first).
+        v_lo, v_hi = _along_rows(fwd, ll)
+        ll, hl = fwd(v_lo)
+        lh, hh = fwd(v_hi)
         bands.append({"HL": hl, "LH": lh, "HH": hh})
     return ll, bands
 
@@ -145,9 +149,9 @@ def dwt2d_forward(x: jnp.ndarray, levels: int, reversible: bool):
 def dwt2d_inverse(ll: jnp.ndarray, bands, reversible: bool):
     inv = _inv53_last if reversible else _inv97_last
     for band in reversed(bands):
-        h_lo = _along_rows(inv, ll, band["LH"])
-        h_hi = _along_rows(inv, band["HL"], band["HH"])
-        ll = inv(h_lo, h_hi)
+        v_lo = inv(ll, band["HL"])
+        v_hi = inv(band["LH"], band["HH"])
+        ll = _along_rows(inv, v_lo, v_hi)
     return ll
 
 

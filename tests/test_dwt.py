@@ -87,8 +87,7 @@ def test_synthesis_gains_sane():
         # HL and LH are transposes of each other: identical gains.
         assert abs(bands[l]["HL"] - bands[l]["LH"]) < 1e-6 * bands[l]["HL"]
         assert bands[l]["HH"] > 0
-    # Finest-level HH norm: ~2.08 == 2 * the classic 1.04 (our highpass
-    # carries the Nyquist-gain-2 convention used for step-size signaling).
-    assert 1.8 < bands[0]["HH"] < 2.4
+    # Finest-level HH synthesis norm under the spec's 1/K / K scaling.
+    assert 0.4 < bands[0]["HH"] < 0.7
     # Gains grow with level depth (coarser bands synthesize more energy).
     assert bands[4]["HL"] > bands[0]["HL"]
