@@ -5,29 +5,27 @@ converters/AbstractConverter.java:29-39).
 
 Pipeline (SURVEY.md §7 minimum slice):
   host image array -> [device] level shift + RCT/ICT + tiled multi-level
-  DWT + quantization (jit/vmap, bucketeer_tpu.codec.pipeline) -> [host]
-  EBCOT Tier-1 per code-block (native C++ / Python reference) -> Tier-2
-  packets -> codestream -> JP2/JPX boxes.
+  DWT + quantization (one jitted XLA program per tile shape,
+  bucketeer_tpu.codec.pipeline; tiles batched per shape group so an
+  image is at most four device calls) -> [host] EBCOT Tier-1 per
+  code-block -> Tier-2 packets -> codestream -> JP2/JPX boxes.
 
-This module is the orchestration; it works standalone on CPU (pure
-numpy/jnp eager) so the service runs in a no-TPU dev mode, mirroring how
-the reference degrades to OpenJPEG when Kakadu is absent
-(reference: converters/ConverterFactory.java:37-47).
+This module is the orchestration; it works standalone on CPU (the same
+jitted program runs on the host backend) so the service runs in a no-TPU
+dev mode, mirroring how the reference degrades to OpenJPEG when Kakadu is
+absent (reference: converters/ConverterFactory.java:37-47).
 """
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 
 import numpy as np
-import jax.numpy as jnp
 
 from . import codestream as cs
 from . import jp2 as jp2box
 from . import t1, t2
-from .dwt import dwt2d_forward, synthesis_gains
-from .quant import (GUARD_BITS, SubbandQuant, quantize, signal_irreversible,
-                    signal_reversible, step_for_subband)
-from .transforms import (ict_forward, level_shift_forward, rct_forward)
+from .pipeline import TilePlan, extract_bands, make_plan, run_tiles
+from .quant import GUARD_BITS, SubbandQuant
 
 CBLK_EXP = 6  # 64x64 code-blocks (reference recipe Cblk={64,64})
 
@@ -53,51 +51,6 @@ class _Band:
     grid: tuple = (0, 0)                              # (nblocks_h, nblocks_w)
 
 
-def _component_planes(img: np.ndarray, bitdepth: int, lossless: bool):
-    """Level shift + color transform. Returns list of planes (numpy)."""
-    x = jnp.asarray(img.astype(np.int32))
-    if img.ndim == 2:
-        shifted = level_shift_forward(x, bitdepth)
-        return ([np.asarray(shifted)], False) if lossless else (
-            [np.asarray(shifted, dtype=np.float32)], False)
-    assert img.shape[2] == 3, "components must be 1 or 3"
-    shifted = level_shift_forward(x, bitdepth)
-    if lossless:
-        ycc = np.asarray(rct_forward(shifted))
-        return [ycc[..., c] for c in range(3)], True
-    ycc = np.asarray(ict_forward(shifted.astype(jnp.float32)))
-    return [ycc[..., c] for c in range(3)], True
-
-
-def _decompose(plane: np.ndarray, levels: int, lossless: bool,
-               bitdepth: int, base_delta: float, rct_extra: int):
-    """DWT + quantize one tile-component -> per-resolution band lists."""
-    arr = jnp.asarray(plane if lossless else plane.astype(np.float32))
-    ll, det = dwt2d_forward(arr, levels, reversible=lossless)
-    ll_gain, gains = synthesis_gains(levels, lossless)
-
-    def make_band(name: str, data, gain: float) -> _Band:
-        a = np.asarray(data)
-        if lossless:
-            q = signal_reversible(bitdepth, name, extra_bits=rct_extra)
-            idx = a.astype(np.int64)
-        else:
-            delta = step_for_subband(base_delta, gain)
-            q = signal_irreversible(delta, bitdepth, name)
-            idx = np.asarray(quantize(jnp.asarray(a), q.delta)).astype(np.int64)
-        return _Band(name, np.abs(idx).astype(np.uint32), (idx < 0), q)
-
-    resolutions = [[make_band("LL", ll, ll_gain)]]
-    for r in range(1, levels + 1):
-        lvl = levels - r  # bands[lvl] is decomposition level lvl+1
-        g = gains[lvl]
-        b = det[lvl]
-        resolutions.append([make_band("HL", b["HL"], g["HL"]),
-                            make_band("LH", b["LH"], g["LH"]),
-                            make_band("HH", b["HH"], g["HH"])])
-    return resolutions
-
-
 def _code_blocks(band: _Band) -> None:
     h, w = band.mags.shape
     if h == 0 or w == 0:
@@ -116,6 +69,23 @@ def _code_blocks(band: _Band) -> None:
                 f"block bitplanes {blk.n_bitplanes} exceed Mb "
                 f"{band.q.n_bitplanes} in {band.name}")
             band.blocks.append(blk)
+
+
+def _tile_bands(planes: np.ndarray, plan: TilePlan):
+    """(C, h, w) coefficient planes -> [component][resolution] band lists
+    with Tier-1 coding applied."""
+    comp_res = []
+    for c in range(planes.shape[0]):
+        resolutions = []
+        for res in extract_bands(planes[c], plan):
+            bands = []
+            for slot, mags, signs in res:
+                band = _Band(slot.name, mags, signs, slot.quant)
+                _code_blocks(band)
+                bands.append(band)
+            resolutions.append(bands)
+        comp_res.append(resolutions)
+    return comp_res
 
 
 def _tile_packets(comp_resolutions: list, n_layers: int,
@@ -177,31 +147,39 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     tile = params.tile_size or max(h, w)
     levels = params.levels
 
-    planes, used_mct = _component_planes(img, bitdepth, params.lossless)
-    rct_extra = 1 if (used_mct and params.lossless) else 0
+    if img.ndim == 2:
+        img = img[..., None]
 
-    tiles = []
-    qcd_values = None
+    # Group tiles by shape: interior tiles batch into one device call;
+    # ragged right/bottom tiles form up to three more groups.
     n_tiles_x = (w + tile - 1) // tile
     n_tiles_y = (h + tile - 1) // tile
+    groups: dict = {}
     for ty in range(n_tiles_y):
         for tx in range(n_tiles_x):
             y0, x0 = ty * tile, tx * tile
-            comp_res = []
-            for plane in planes:
-                sub = plane[y0:y0 + tile, x0:x0 + tile]
-                res = _decompose(sub, levels, params.lossless, bitdepth,
-                                 params.base_delta, rct_extra)
-                for bands in res:
-                    for band in bands:
-                        _code_blocks(band)
-                comp_res.append(res)
+            th, tw = min(tile, h - y0), min(tile, w - x0)
+            groups.setdefault((th, tw), []).append(
+                (ty * n_tiles_x + tx, y0, x0))
+
+    tiles = []
+    qcd_values = None
+    for (th, tw), members in groups.items():
+        plan = make_plan(th, tw, n_comps, levels, params.lossless, bitdepth,
+                         params.base_delta)
+        batch = np.stack([img[y0:y0 + th, x0:x0 + tw]
+                          for _, y0, x0 in members])
+        planes = run_tiles(plan, batch)              # (B, C, th, tw)
+        if qcd_values is None:
+            qcd_values = _qcd_values(plan)
+        for (tidx, _, _), tile_planes in zip(members, planes):
+            comp_res = _tile_bands(tile_planes, plan)
             packets = _tile_packets(comp_res, params.n_layers,
                                     params.progression)
-            tiles.append((ty * n_tiles_x + tx, [], packets))
-            if qcd_values is None:
-                qcd_values = _qcd_values(comp_res[0], params.lossless)
+            tiles.append((tidx, [], packets))
+    tiles.sort(key=lambda item: item[0])
 
+    used_mct = n_comps == 3
     segs = [
         cs.siz(w, h, n_comps, bitdepth, tile, tile),
         cs.cod(params.progression, params.n_layers,
@@ -215,14 +193,13 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     return cs.assemble(segs, tiles)
 
 
-def _qcd_values(resolutions: list, lossless: bool) -> list:
+def _qcd_values(plan: TilePlan) -> list:
     vals = []
-    for bands in resolutions:
-        for band in bands:
-            if lossless:
-                vals.append(band.q.exponent)
-            else:
-                vals.append((band.q.exponent, band.q.mantissa))
+    for slot in plan.slots:
+        if plan.lossless:
+            vals.append(slot.quant.exponent)
+        else:
+            vals.append((slot.quant.exponent, slot.quant.mantissa))
     return vals
 
 
