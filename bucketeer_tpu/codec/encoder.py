@@ -144,6 +144,7 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     params = params or EncodeParams()
     h, w = img.shape[:2]
     n_comps = 1 if img.ndim == 2 else img.shape[2]
+    assert n_comps in (1, 3), "components must be 1 or 3"
     tile = params.tile_size or max(h, w)
     levels = params.levels
 

@@ -37,8 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .dwt import dwt2d_forward, synthesis_gains
-from .quant import (SubbandQuant, signal_irreversible, signal_reversible,
-                    step_for_subband)
+from .quant import (SubbandQuant, quantize, signal_irreversible,
+                    signal_reversible, step_for_subband)
 from .transforms import ict_forward, level_shift_forward, rct_forward
 
 
@@ -156,20 +156,26 @@ def _transform_batch(plan: TilePlan, step_map: jnp.ndarray,
     coeffs = _mallat(ll, bands)
     if plan.lossless:
         return coeffs.astype(jnp.int32)
-    q = jnp.floor(jnp.abs(coeffs) / step_map).astype(jnp.int32)
-    return jnp.where(coeffs < 0, -q, q)
+    return quantize(coeffs, step_map)
 
 
 @lru_cache(maxsize=256)
 def compiled_transform(plan: TilePlan):
-    """The jitted device computation for one plan. Cached per plan so each
-    tile shape compiles exactly once per process."""
+    """The jitted device computation for one plan. XLA still specializes
+    on the batch size; callers bound retraces by padding B to a bucket
+    size (:func:`run_tiles`)."""
     step_map = jnp.asarray(_step_map(plan)) if not plan.lossless else None
-    if plan.lossless:
-        fn = jax.jit(partial(_transform_batch, plan, None))
-    else:
-        fn = jax.jit(partial(_transform_batch, plan, step_map))
-    return fn
+    return jax.jit(partial(_transform_batch, plan, step_map))
+
+
+def _bucket(b: int) -> int:
+    """Round a batch size up to the next power of two so a long-running
+    service compiles O(log max-batch) programs per tile shape, not one
+    per distinct tile count."""
+    n = 1
+    while n < b:
+        n <<= 1
+    return n
 
 
 def run_tiles(plan: TilePlan, tiles: np.ndarray) -> np.ndarray:
@@ -177,9 +183,14 @@ def run_tiles(plan: TilePlan, tiles: np.ndarray) -> np.ndarray:
     (B, C, h, w) int32 on host."""
     if tiles.ndim == 3:
         tiles = tiles[..., None]
+    b = tiles.shape[0]
+    pad = _bucket(b) - b
+    if pad:
+        tiles = np.concatenate(
+            [tiles, np.zeros((pad,) + tiles.shape[1:], tiles.dtype)])
     fn = compiled_transform(plan)
     out = fn(jnp.asarray(tiles))
-    return np.asarray(jax.device_get(out))
+    return np.asarray(jax.device_get(out))[:b]
 
 
 def extract_bands(plane: np.ndarray, plan: TilePlan):

@@ -1,0 +1,37 @@
+"""Data-parallel tile batching over the ``data`` mesh axis.
+
+The Lambda fan-out analog (reference: README.md:176 — up to 1000
+concurrent converter functions; handlers/LoadCsvHandler.java:256-263
+dispatches one item at a time): here a batch of same-shape tiles is laid
+out with its leading dimension sharded across the mesh, and the fused
+transform (codec/pipeline.py) runs SPMD — tiles are independent, so XLA
+generates zero communication.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..codec.pipeline import TilePlan, compiled_transform
+from .mesh import DATA_AXIS, batch_sharding
+
+
+def run_tiles_sharded(plan: TilePlan, tiles: np.ndarray,
+                      mesh: Mesh) -> np.ndarray:
+    """Like :func:`bucketeer_tpu.codec.pipeline.run_tiles` but with the
+    batch dimension sharded over the mesh's data axis. Pads the batch up
+    to a multiple of the axis size (padding tiles are stripped on
+    return)."""
+    if tiles.ndim == 3:
+        tiles = tiles[..., None]
+    b = tiles.shape[0]
+    n = mesh.shape[DATA_AXIS]
+    pad = (-b) % n
+    if pad:
+        tiles = np.concatenate(
+            [tiles, np.zeros((pad,) + tiles.shape[1:], tiles.dtype)])
+    fn = compiled_transform(plan)
+    arr = jax.device_put(tiles, batch_sharding(mesh))
+    out = np.asarray(jax.device_get(fn(arr)))
+    return out[:b]

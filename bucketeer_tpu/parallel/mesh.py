@@ -1,0 +1,57 @@
+"""Device-mesh plumbing for multi-chip encodes.
+
+The reference scales by fanning items out to up to 1000 AWS Lambda
+functions and routing oversized images whole to a second service instance
+(reference: README.md:176, handlers/LoadCsvHandler.java:256-281,
+verticles/LargeImageVerticle.java:72-97). The TPU-native design replaces
+both with a single device mesh:
+
+- axis ``data``  — batch/data parallelism over tiles or images (the
+  Lambda fan-out analog);
+- axis ``tile``  — spatial parallelism *inside* one huge tile (the
+  large-image analog: decompose instead of route), with DWT halo
+  exchange between row-neighbor shards over ICI (see
+  :mod:`bucketeer_tpu.parallel.sharded_dwt`).
+
+Collectives ride ICI inside a slice; DCN is only used for host-level job
+dispatch (SURVEY.md §2.3, §5).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+TILE_AXIS = "tile"
+
+
+def make_mesh(devices=None, tile_parallel: int = 1) -> Mesh:
+    """Build a ('data', 'tile') mesh from the available devices.
+
+    ``tile_parallel`` devices cooperate on one spatial shard group; the
+    rest of the devices form the data axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % tile_parallel:
+        raise ValueError(f"{n} devices not divisible by tile_parallel="
+                         f"{tile_parallel}")
+    arr = np.asarray(devices).reshape(n // tile_parallel, tile_parallel)
+    return Mesh(arr, (DATA_AXIS, TILE_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a (B, h, w, C) tile batch: split B across the data
+    axis (tiles are independent — no communication is generated)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for one (H, W) or (H, W, C) giant tile: split rows across
+    the tile axis."""
+    return NamedSharding(mesh, P(TILE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,117 @@
+"""Row-sharded multi-level 2-D DWT with halo exchange over ICI.
+
+This is the spatial/context-parallel analog for this workload (SURVEY.md
+§5 "long-context"): where the reference routes over-sized images *whole*
+to a dedicated second service instance
+(reference: verticles/LargeImageVerticle.java:72-97,
+handlers/LoadCsvHandler.java:270-281), the TPU design decomposes — one
+huge tile's rows are sharded across the ``tile`` mesh axis and the
+vertical lifting passes exchange 4-row halos with row-neighbor shards via
+``lax.ppermute`` (ring pattern, ICI traffic only; the horizontal pass is
+fully local).
+
+Correctness argument: every lifting step reads ±1 row of the other
+parity, and valid data shrinks by one row per step from each halo edge;
+4 halo rows cover the 4-step 9/7 schedule (2-step 5/3 a fortiori), so
+after cropping the halos every local row equals the unsharded transform.
+Global symmetric boundary extension is reproduced at the outer shards by
+reflecting their own edge rows. Each shard keeps an even number of rows
+at every level, so the even/odd polyphase split — and therefore the
+subband row ordering — is shard-local with no resharding between levels.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..codec.dwt import (ALPHA, BETA, DELTA, GAMMA, K_HI, K_LO,
+                         _fwd53_last, _fwd97_last)
+from .mesh import TILE_AXIS
+
+HALO = 4  # covers the 4-step 9/7 lifting support
+
+
+def _halo_pad(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Pad local rows (..., Hs, W) with HALO rows from row-neighbor
+    shards; outer shards reflect their own boundary (symmetric
+    extension)."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    up_perm = [(i, i + 1) for i in range(n - 1)]      # recv from idx-1
+    down_perm = [(i + 1, i) for i in range(n - 1)]    # recv from idx+1
+    up = jax.lax.ppermute(x[..., -HALO:, :], axis_name, up_perm)
+    down = jax.lax.ppermute(x[..., :HALO, :], axis_name, down_perm)
+    top_reflect = jnp.flip(x[..., 1:HALO + 1, :], axis=-2)
+    bot_reflect = jnp.flip(x[..., -HALO - 1:-1, :], axis=-2)
+    up = jnp.where(idx == 0, top_reflect, up)
+    down = jnp.where(idx == n - 1, bot_reflect, down)
+    return jnp.concatenate([up, x, down], axis=-2)
+
+
+def _vlift_fwd(xp: jnp.ndarray, reversible: bool) -> jnp.ndarray:
+    """Forward vertical lifting over a halo-padded block. Row parity of
+    the padded local index equals global parity (shard heights and HALO
+    are even)."""
+    rows = np.arange(xp.shape[-2])
+    even = jnp.asarray(rows % 2 == 0)[:, None]
+    odd = jnp.asarray(rows % 2 == 1)[:, None]
+
+    def nbr(y):
+        return jnp.roll(y, 1, axis=-2) + jnp.roll(y, -1, axis=-2)
+
+    if reversible:
+        xp = jnp.where(odd, xp - (nbr(xp) >> 1), xp)
+        xp = jnp.where(even, xp + ((nbr(xp) + 2) >> 2), xp)
+    else:
+        xp = xp.astype(jnp.float32)
+        xp = jnp.where(odd, xp + ALPHA * nbr(xp), xp)
+        xp = jnp.where(even, xp + BETA * nbr(xp), xp)
+        xp = jnp.where(odd, xp + GAMMA * nbr(xp), xp)
+        xp = jnp.where(even, xp + DELTA * nbr(xp), xp)
+    return xp
+
+
+def _local_dwt(levels: int, reversible: bool, axis_name: str,
+               x: jnp.ndarray):
+    """shard_map body: multi-level DWT of this shard's rows."""
+    fwd = _fwd53_last if reversible else _fwd97_last
+    ll = x if reversible else x.astype(jnp.float32)
+    bands = []
+    for _ in range(levels):
+        hs = ll.shape[-2]
+        if hs % 2 or hs < HALO + 1:
+            raise ValueError(
+                f"shard rows {hs} must be even and > {HALO} at every "
+                f"level; pick tile_parallel/levels so H/(shards*2^levels) "
+                f"stays >= {HALO + 1}")
+        xp = _vlift_fwd(_halo_pad(ll, axis_name), reversible)
+        core = xp[..., HALO:-HALO, :]
+        v_lo, v_hi = core[..., 0::2, :], core[..., 1::2, :]
+        if not reversible:
+            v_lo, v_hi = K_LO * v_lo, K_HI * v_hi
+        ll, hl = fwd(v_lo)
+        lh, hh = fwd(v_hi)
+        bands.append({"HL": hl, "LH": lh, "HH": hh})
+    return ll, bands
+
+
+def sharded_dwt2d_forward(x: jnp.ndarray, levels: int, reversible: bool,
+                          mesh: Mesh):
+    """Multi-level forward DWT of one giant tile, rows sharded over the
+    ``tile`` mesh axis.
+
+    x: (H, W) or (C, H, W) with H divisible by (tile-axis size × 2^levels).
+    Returns (ll, bands) row-sharded identically to
+    :func:`bucketeer_tpu.codec.dwt.dwt2d_forward`'s layout.
+    """
+    row = tuple(None for _ in range(x.ndim - 2)) + (TILE_AXIS, None)
+    spec = P(*row)
+    fn = shard_map(partial(_local_dwt, levels, reversible, TILE_AXIS),
+                   mesh=mesh, in_specs=(spec,), out_specs=spec,
+                   check_vma=False)
+    return fn(x)

@@ -1,0 +1,77 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh — the
+analog of the reference's container-based integration tier (SURVEY.md
+§4): exercise the distributed seams without real hardware.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bucketeer_tpu.codec.dwt import dwt2d_forward
+from bucketeer_tpu.codec.pipeline import make_plan, run_tiles
+from bucketeer_tpu.parallel import (make_mesh, run_tiles_sharded,
+                                    sharded_dwt2d_forward)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(tile_parallel=8)       # 1 x 8: all devices spatial
+
+
+@pytest.fixture(scope="module")
+def mesh42():
+    return make_mesh(tile_parallel=2)       # 4 x 2: data x tile
+
+
+def test_mesh_axes():
+    m = make_mesh(tile_parallel=2)
+    assert m.shape == {"data": 4, "tile": 2}
+
+
+@pytest.mark.parametrize("reversible", [True, False])
+def test_sharded_dwt_matches_single_device(rng, mesh8, reversible):
+    h, w, levels = 256, 64, 2               # 256/(8*4)=8 rows at coarsest
+    x = rng.integers(-1000, 1000, size=(h, w)).astype(np.int32)
+    ref_ll, ref_bands = dwt2d_forward(
+        jnp.asarray(x if reversible else x.astype(np.float32)),
+        levels, reversible)
+    ll, bands = sharded_dwt2d_forward(jnp.asarray(
+        x if reversible else x.astype(np.float32)),
+        levels, reversible, mesh8)
+    if reversible:
+        np.testing.assert_array_equal(np.asarray(ll), np.asarray(ref_ll))
+        for got, ref in zip(bands, ref_bands):
+            for k in ("HL", "LH", "HH"):
+                np.testing.assert_array_equal(np.asarray(got[k]),
+                                              np.asarray(ref[k]))
+    else:
+        np.testing.assert_allclose(np.asarray(ll), np.asarray(ref_ll),
+                                   rtol=1e-5, atol=1e-3)
+        for got, ref in zip(bands, ref_bands):
+            for k in ("HL", "LH", "HH"):
+                np.testing.assert_allclose(np.asarray(got[k]),
+                                           np.asarray(ref[k]),
+                                           rtol=1e-5, atol=1e-3)
+
+
+def test_sharded_dwt_multicomponent(rng, mesh8):
+    x = rng.integers(-500, 500, size=(3, 128, 32)).astype(np.int32)
+    ref_ll, _ = dwt2d_forward(jnp.asarray(x), 1, True)
+    ll, _ = sharded_dwt2d_forward(jnp.asarray(x), 1, True, mesh8)
+    np.testing.assert_array_equal(np.asarray(ll), np.asarray(ref_ll))
+
+
+def test_sharded_tile_batch_matches_local(rng, mesh42):
+    plan = make_plan(64, 64, 3, 3, False, 8)
+    tiles = rng.integers(0, 256, size=(10, 64, 64, 3)).astype(np.uint8)
+    ref = run_tiles(plan, tiles)
+    got = run_tiles_sharded(plan, tiles, mesh42)   # 10 pads to 12 over 4
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sharded_tile_batch_lossless(rng, mesh42):
+    plan = make_plan(32, 32, 1, 2, True, 8)
+    tiles = rng.integers(0, 256, size=(8, 32, 32)).astype(np.uint8)
+    np.testing.assert_array_equal(
+        run_tiles_sharded(plan, tiles, mesh42),
+        run_tiles(plan, tiles))
