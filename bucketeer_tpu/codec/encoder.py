@@ -23,7 +23,7 @@ import numpy as np
 
 from . import codestream as cs
 from . import jp2 as jp2box
-from . import t1, t2
+from . import t1, t1_batch, t2
 from .pipeline import TilePlan, extract_bands, make_plan, run_tiles
 from .quant import GUARD_BITS, SubbandQuant
 
@@ -51,7 +51,8 @@ class _Band:
     grid: tuple = (0, 0)                              # (nblocks_h, nblocks_w)
 
 
-def _code_blocks(band: _Band) -> None:
+def _collect_blocks(band: _Band, specs: list, dests: list) -> None:
+    """Append this band's code-block inputs to the global batch."""
     h, w = band.mags.shape
     if h == 0 or w == 0:
         band.grid = (0, 0)
@@ -62,18 +63,15 @@ def _code_blocks(band: _Band) -> None:
     for by in range(nbh):
         for bx in range(nbw):
             y0, x0 = by << CBLK_EXP, bx << CBLK_EXP
-            mags = band.mags[y0:y0 + 64, x0:x0 + 64]
-            signs = band.signs[y0:y0 + 64, x0:x0 + 64]
-            blk = t1.encode_block(mags, signs, band.name)
-            assert blk.n_bitplanes <= band.q.n_bitplanes, (
-                f"block bitplanes {blk.n_bitplanes} exceed Mb "
-                f"{band.q.n_bitplanes} in {band.name}")
-            band.blocks.append(blk)
+            specs.append((band.mags[y0:y0 + 64, x0:x0 + 64],
+                          band.signs[y0:y0 + 64, x0:x0 + 64], band.name))
+            dests.append(band)
 
 
-def _tile_bands(planes: np.ndarray, plan: TilePlan):
-    """(C, h, w) coefficient planes -> [component][resolution] band lists
-    with Tier-1 coding applied."""
+def _tile_bands(planes: np.ndarray, plan: TilePlan, specs: list,
+                dests: list):
+    """(C, h, w) coefficient planes -> [component][resolution] band lists,
+    queueing code-block inputs into the global Tier-1 batch."""
     comp_res = []
     for c in range(planes.shape[0]):
         resolutions = []
@@ -81,7 +79,7 @@ def _tile_bands(planes: np.ndarray, plan: TilePlan):
             bands = []
             for slot, mags, signs in res:
                 band = _Band(slot.name, mags, signs, slot.quant)
-                _code_blocks(band)
+                _collect_blocks(band, specs, dests)
                 bands.append(band)
             resolutions.append(bands)
         comp_res.append(resolutions)
@@ -163,7 +161,11 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
             groups.setdefault((th, tw), []).append(
                 (ty * n_tiles_x + tx, y0, x0))
 
-    tiles = []
+    # Phase 1: device transforms (batched per shape group) and code-block
+    # collection across the whole image.
+    specs: list = []
+    dests: list = []
+    tile_records = []
     qcd_values = None
     for (th, tw), members in groups.items():
         plan = make_plan(th, tw, n_comps, levels, params.lossless, bitdepth,
@@ -174,10 +176,32 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         if qcd_values is None:
             qcd_values = _qcd_values(plan)
         for (tidx, _, _), tile_planes in zip(members, planes):
-            comp_res = _tile_bands(tile_planes, plan)
-            packets = _tile_packets(comp_res, params.n_layers,
-                                    params.progression)
-            tiles.append((tidx, [], packets))
+            comp_res = _tile_bands(tile_planes, plan, specs, dests)
+            tile_records.append((tidx, comp_res))
+
+    # Phase 2: one Tier-1 batch over every code-block in the image (native
+    # thread pool when available).
+    for band, blk in zip(dests, t1_batch.encode_blocks(specs)):
+        assert blk.n_bitplanes <= band.q.n_bitplanes, (
+            f"block bitplanes {blk.n_bitplanes} exceed Mb "
+            f"{band.q.n_bitplanes} in {band.name}")
+        band.blocks.append(blk)
+    # Coefficients are fully entropy-coded now; drop them so a huge image
+    # doesn't hold every tile's magnitude/sign planes through Tier-2.
+    specs.clear()
+    dests.clear()
+    for _, comp_res in tile_records:
+        for resolutions in comp_res:
+            for bands in resolutions:
+                for band in bands:
+                    band.mags = band.signs = None
+
+    # Phase 3: Tier-2 packets per tile.
+    tiles = []
+    for tidx, comp_res in tile_records:
+        packets = _tile_packets(comp_res, params.n_layers,
+                                params.progression)
+        tiles.append((tidx, [], packets))
     tiles.sort(key=lambda item: item[0])
 
     used_mct = n_comps == 3

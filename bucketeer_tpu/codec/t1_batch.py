@@ -1,0 +1,79 @@
+"""Batched Tier-1 dispatch: native C++ thread pool when available, pure
+Python fallback otherwise (reference analog: ConverterFactory probing for
+Kakadu and falling back, converters/ConverterFactory.java:37-47).
+
+The whole image's code-blocks go through one call so the native thread
+pool sees the full parallelism (blocks are independent — SURVEY.md §7).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import native
+from . import t1
+
+_BAND_CLS = {"LL": 0, "LH": 0, "HH": 1, "HL": 2}
+
+
+def default_threads() -> int:
+    env = os.environ.get("BUCKETEER_T1_THREADS")
+    if env:
+        return max(1, int(env))
+    return max(1, (os.cpu_count() or 2) - 1)
+
+
+def encode_blocks(specs: list) -> list:
+    """specs: [(mags uint32 (h,w), signs bool (h,w), band_name)] ->
+    [t1.CodedBlock] in order."""
+    lib = native.load()
+    if lib is None or not specs:
+        return [t1.encode_block(m, s, b) for m, s, b in specs]
+
+    n = len(specs)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    hs = np.zeros(n, dtype=np.int32)
+    ws = np.zeros(n, dtype=np.int32)
+    cls = np.zeros(n, dtype=np.int32)
+    for i, (m, _, band) in enumerate(specs):
+        hs[i], ws[i] = m.shape
+        cls[i] = _BAND_CLS[band]
+        offsets[i + 1] = offsets[i] + m.size
+    total = int(offsets[-1])
+    mags = np.empty(total, dtype=np.uint32)
+    negs = np.empty(total, dtype=np.uint8)
+    for i, (m, s, _) in enumerate(specs):
+        mags[offsets[i]:offsets[i + 1]] = np.ascontiguousarray(
+            m, dtype=np.uint32).ravel()
+        negs[offsets[i]:offsets[i + 1]] = np.ascontiguousarray(
+            s, dtype=np.uint8).ravel()
+
+    handle = lib.t1_encode_blocks(
+        n, mags.ctypes.data, negs.ctypes.data, offsets.ctypes.data,
+        hs.ctypes.data, ws.ctypes.data, cls.ctypes.data, default_threads())
+    try:
+        nbps = np.zeros(n, dtype=np.int32)
+        npasses = np.zeros(n, dtype=np.int32)
+        nbytes = np.zeros(n, dtype=np.int64)
+        lib.t1_block_sizes(handle, nbps.ctypes.data, npasses.ctypes.data,
+                           nbytes.ctypes.data)
+        out = []
+        for i in range(n):
+            np_i, nb_i = int(npasses[i]), int(nbytes[i])
+            data = np.empty(max(nb_i, 1), dtype=np.uint8)
+            ptype = np.zeros(max(np_i, 1), dtype=np.int32)
+            pplane = np.zeros(max(np_i, 1), dtype=np.int32)
+            plen = np.zeros(max(np_i, 1), dtype=np.int64)
+            pdist = np.zeros(max(np_i, 1), dtype=np.float64)
+            lib.t1_block_get(handle, i, data.ctypes.data, ptype.ctypes.data,
+                             pplane.ctypes.data, plen.ctypes.data,
+                             pdist.ctypes.data)
+            passes = [t1.PassInfo(int(ptype[k]), int(pplane[k]),
+                                  int(plen[k]), float(pdist[k]))
+                      for k in range(np_i)]
+            out.append(t1.CodedBlock(bytes(data[:nb_i].tobytes()),
+                                     int(nbps[i]), passes))
+        return out
+    finally:
+        lib.t1_result_free(handle)

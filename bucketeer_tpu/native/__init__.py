@@ -1,0 +1,75 @@
+"""ctypes bindings for the native Tier-1 coder (t1.cpp).
+
+The production entropy-coding path: batches of code-blocks are encoded in
+C++ across a thread pool (cores-1 threads by default, mirroring the
+reference's uploader-pool sizing, reference:
+verticles/MainVerticle.java:64-77). Falls back transparently to the pure
+Python coder when the shared library is missing and cannot be built
+(e.g. no compiler in the deployment image) — the analog of the
+reference's Kakadu-to-OpenJPEG degradation
+(reference: converters/ConverterFactory.java:37-47).
+
+Set ``BUCKETEER_NO_NATIVE=1`` to force the Python path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+_DIR = Path(__file__).resolve().parent
+_LIB_PATH = _DIR / "libbucketeer_t1.so"
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    src = _DIR / "t1.cpp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+             "-o", str(_LIB_PATH), str(src)],
+            check=True, capture_output=True, timeout=300)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("BUCKETEER_NO_NATIVE"):
+        return None
+    src = _DIR / "t1.cpp"
+    try:
+        stale = (not _LIB_PATH.exists()
+                 or _LIB_PATH.stat().st_mtime < src.stat().st_mtime)
+    except OSError:
+        stale = False        # source pruned from deployment; use the .so
+    if stale and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError:
+        return None
+    lib.t1_encode_blocks.restype = ctypes.c_void_p
+    lib.t1_encode_blocks.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+    lib.t1_block_sizes.restype = None
+    lib.t1_block_sizes.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 3
+    lib.t1_block_get.restype = None
+    lib.t1_block_get.argtypes = [ctypes.c_void_p, ctypes.c_int] + \
+        [ctypes.c_void_p] * 5
+    lib.t1_result_free.restype = None
+    lib.t1_result_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
