@@ -13,7 +13,9 @@ Package layout (SURVEY.md §7 build plan):
 
 - :mod:`bucketeer_tpu.codec`       — the JPEG 2000 encoder (the real work)
 - :mod:`bucketeer_tpu.converters`  — Converter SPI (TpuConverter, CliConverter)
-- :mod:`bucketeer_tpu.engine`      — Job/Item/JobFactory model + async job engine
+- :mod:`bucketeer_tpu.models` / :mod:`bucketeer_tpu.job_factory`
+                                   — Job/Item/WorkflowState model, CSV parser
+- :mod:`bucketeer_tpu.engine`      — async job engine (bus, workers, S3)
 - :mod:`bucketeer_tpu.server`      — OpenAPI HTTP layer + web UI
 - :mod:`bucketeer_tpu.parallel`    — device mesh sharding, batch scheduler
 - :mod:`bucketeer_tpu.utils`       — path prefixes, message codes

@@ -1,0 +1,49 @@
+"""Host-side TIFF reading: source images -> numpy arrays for the device
+pipeline.
+
+Replaces the reference's reliance on libtiff inside ``kdu_compress``
+(reference: src/main/docker/Dockerfile:17-19,54-55 installs libtiff for the
+Kakadu binary to consume). Supports 8/16-bit grayscale and RGB — the
+archival-scan formats named in BASELINE.md configs 1 and 3.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def read_image(path: str) -> tuple[np.ndarray, int]:
+    """Read an image file into ``(array, bitdepth)``.
+
+    Returns (H, W) for grayscale or (H, W, 3) for color, dtype uint8 or
+    uint16. Alpha channels are dropped; palette images are expanded.
+    """
+    from PIL import Image
+
+    with Image.open(path) as img:
+        if img.mode == "P":
+            img = img.convert("RGB")
+        elif img.mode == "1":   # bilevel -> 0/255 grayscale
+            img = img.convert("L")
+        elif img.mode in ("LA", "RGBA"):
+            img = img.convert(img.mode[:-1])
+        elif img.mode == "CMYK":
+            img = img.convert("RGB")
+        arr = np.asarray(img)
+
+    if arr.ndim == 3 and arr.shape[2] == 4:
+        arr = arr[:, :, :3]
+    if arr.dtype == np.int32:  # PIL 'I' mode: 32-bit container for 16-bit data
+        arr = np.clip(arr, 0, 65535).astype(np.uint16)
+    if arr.dtype == np.uint16:
+        return arr, 16
+    if arr.dtype != np.uint8:
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    return arr, 8
+
+
+def image_size(path: str) -> tuple[int, int]:
+    """(width, height) without decoding pixel data."""
+    from PIL import Image
+
+    with Image.open(path) as img:
+        return img.size

@@ -1,0 +1,21 @@
+"""Converter SPI: pluggable TIFF -> JPEG 2000 conversion.
+
+Port of the reference's converter layer (reference:
+src/main/java/edu/ucla/library/bucketeer/converters/Converter.java:22,
+ConverterFactory.java:37-103, KakaduConverter.java:34-77,
+OpenJPEGConverter.java:12-25, AbstractConverter.java:29-39) with the
+roles inverted: the in-process TPU encoder is the primary converter (the
+reference shells out to the Kakadu binary for this), and the CLI
+converters wrap ``kdu_compress`` / ``opj_compress`` when installed — as a
+correctness oracle and a no-TPU dev mode.
+"""
+from .base import Conversion, Converter, ConverterError, output_path
+from .cli import CliConverter, KakaduConverter, OpenJPEGConverter
+from .factory import available_converters, get_converter
+from .tpu import TpuConverter
+
+__all__ = [
+    "Conversion", "Converter", "ConverterError", "output_path",
+    "CliConverter", "KakaduConverter", "OpenJPEGConverter",
+    "TpuConverter", "get_converter", "available_converters",
+]

@@ -1,0 +1,48 @@
+"""Converter selection (reference: converters/ConverterFactory.java:37-70
+probes for Kakadu and falls back to OpenJPEG; here the TPU encoder is the
+default and the CLI tools are opt-in/fallback).
+
+Selection order:
+1. ``BUCKETEER_CONVERTER`` env (``tpu`` | ``kakadu`` | ``openjpeg``);
+2. the in-process TPU converter (always available);
+"""
+from __future__ import annotations
+
+import os
+
+from .base import Converter
+from .cli import KakaduConverter, OpenJPEGConverter
+from .tpu import TpuConverter
+
+_BY_NAME = {
+    "tpu": TpuConverter,
+    "kakadu": KakaduConverter,
+    "openjpeg": OpenJPEGConverter,
+}
+
+_instance: Converter | None = None
+
+
+def available_converters() -> dict[str, bool]:
+    return {
+        "tpu": True,
+        "kakadu": KakaduConverter.is_available(),
+        "openjpeg": OpenJPEGConverter.is_available(),
+    }
+
+
+def get_converter(name: str | None = None) -> Converter:
+    """Resolve (and cache) the process-wide converter instance."""
+    global _instance
+    if name is None and _instance is not None:
+        return _instance
+    choice = (name or os.environ.get("BUCKETEER_CONVERTER") or "tpu").lower()
+    cls = _BY_NAME.get(choice)
+    if cls is None:
+        raise ValueError(f"unknown converter: {choice}")
+    if cls is not TpuConverter and not cls.is_available():
+        cls = TpuConverter
+    converter = cls()
+    if name is None:
+        _instance = converter
+    return converter

@@ -1,0 +1,208 @@
+"""Domain-model tests: Job/Item/WorkflowState/JobFactory CSV semantics.
+
+Ports the reference's model test coverage (reference:
+src/test/java/edu/ucla/library/bucketeer/JobTest.java, ItemTest.java,
+JobFactoryTest.java) — CSV parsing rules, state machine, serialization,
+metadata update and CSV output.
+"""
+import json
+import os
+
+import pytest
+
+from bucketeer_tpu import job_factory, models as m
+from bucketeer_tpu.utils import path_prefix as pp
+
+CSV_BASIC = """Item ARK,File Name,Object Type,viewingHint
+ark:/111/aaa,one.tif,Work,
+ark:/111/bbb,two.tif,Work,
+"""
+
+CSV_STRUCTURAL = """Item ARK,File Name,Object Type,viewingHint
+ark:/111/coll,,Collection,
+ark:/111/page,three.tif,Work,paged
+ark:/111/ccc,four.tif,Work,
+"""
+
+CSV_SUBSEQUENT = """Item ARK,File Name,Object Type,viewingHint,Bucketeer State,IIIF Access URL
+ark:/1/a,a.tif,Work,,failed,
+ark:/1/b,b.tif,Work,,missing,
+ark:/1/c,c.tif,Work,,succeeded,http://iiif/abc
+ark:/1/d,d.tif,Work,,,
+"""
+
+
+@pytest.fixture
+def image_dir(tmp_path):
+    for name in ("one.tif", "two.tif", "three.tif", "four.tif",
+                 "a.tif", "b.tif", "c.tif", "d.tif"):
+        (tmp_path / name).write_bytes(b"II*\x00 fake tiff")
+    return str(tmp_path)
+
+
+def _prefix(image_dir):
+    return pp.GenericFilePathPrefix(image_dir)
+
+
+class TestWorkflowState:
+    def test_empty_maps_to_blank_string(self):
+        assert str(m.WorkflowState.EMPTY) == ""
+        assert m.WorkflowState.from_string("") is m.WorkflowState.EMPTY
+        assert m.WorkflowState.from_string(None) is m.WorkflowState.EMPTY
+
+    def test_round_trip_names(self):
+        for st in m.WorkflowState:
+            assert m.WorkflowState.from_string(str(st)) is st
+
+    def test_invalid_raises(self):
+        with pytest.raises(ValueError):
+            m.WorkflowState.from_string("bogus")
+
+
+class TestJobFactory:
+    def test_basic_parse(self, image_dir):
+        job = job_factory.create_job("j1", CSV_BASIC, prefix=_prefix(image_dir))
+        assert job.name == "j1"
+        assert len(job.items) == 2
+        assert job.remaining() == 2
+        assert job.items[0].id == "ark:/111/aaa"
+        assert job.items[0].get_file() == os.path.join(image_dir, "one.tif")
+
+    def test_missing_required_header(self, image_dir):
+        with pytest.raises(m.ProcessingException) as exc:
+            job_factory.create_job("j", "Item ARK,Object Type\nx,y\n",
+                                   prefix=_prefix(image_dir))
+        assert "File Name" in str(exc.value)
+
+    def test_duplicate_headers_rejected(self, image_dir):
+        # reference: JobFactory.java:272-333, fixture dupe-headers.csv
+        csv_text = "Item ARK,File Name,File Name\nx,a.tif,b.tif\n"
+        with pytest.raises(m.ProcessingException) as exc:
+            job_factory.create_job("j", csv_text, prefix=_prefix(image_dir))
+        assert "duplicate" in str(exc.value)
+
+    def test_spaces_in_file_name_rejected(self, image_dir):
+        # reference: JobFactory.java:173-179, fixture spaces-file.csv
+        csv_text = "Item ARK,File Name\nark:/1/x,bad name.tif\n"
+        with pytest.raises(job_factory.JobCreationWarnings) as exc:
+            job_factory.create_job("j", csv_text, prefix=_prefix(image_dir))
+        job = exc.value.job
+        assert job.items[0].workflow_state is m.WorkflowState.FAILED
+        assert "spaces" in str(exc.value)
+
+    def test_structural_rows(self, image_dir):
+        # reference: JobFactory.java:203-233 — Collection, or Work+viewingHint
+        job = job_factory.create_job("j", CSV_STRUCTURAL,
+                                     prefix=_prefix(image_dir))
+        states = [i.workflow_state for i in job.items]
+        assert states[0] is m.WorkflowState.STRUCTURAL
+        assert states[1] is m.WorkflowState.STRUCTURAL
+        assert states[2] is m.WorkflowState.EMPTY
+        assert job.items[0].is_structural()
+        assert not job.items[0].has_file()
+        assert job.remaining() == 1
+
+    def test_missing_file_state(self, image_dir):
+        csv_text = "Item ARK,File Name\nark:/1/x,nope.tif\n"
+        with pytest.raises(job_factory.JobCreationWarnings) as exc:
+            job_factory.create_job("j", csv_text, prefix=_prefix(image_dir))
+        job = exc.value.job
+        assert job.items[0].workflow_state is m.WorkflowState.MISSING
+        assert "not found" in str(exc.value)
+
+    def test_subsequent_run_state_machine(self, image_dir):
+        # reference: JobFactory.java:217-225 — failed/missing -> EMPTY,
+        # succeeded -> INGESTED
+        job = job_factory.create_job("j", CSV_SUBSEQUENT, subsequent_run=True,
+                                     prefix=_prefix(image_dir))
+        states = [i.workflow_state for i in job.items]
+        assert states[0] is m.WorkflowState.EMPTY      # failed -> retry
+        assert states[1] is m.WorkflowState.EMPTY      # missing -> retry
+        assert states[2] is m.WorkflowState.INGESTED   # succeeded -> done
+        assert states[3] is m.WorkflowState.EMPTY      # still empty
+        assert job.remaining() == 3
+        assert job.is_subsequent_run
+
+    def test_first_run_ignores_prior_state(self, image_dir):
+        job = job_factory.create_job("j", CSV_SUBSEQUENT, subsequent_run=False,
+                                     prefix=_prefix(image_dir))
+        assert job.remaining() == 4
+
+    def test_blank_rows_skipped(self, image_dir):
+        csv_text = "Item ARK,File Name\nark:/1/a,one.tif\n,\n\n"
+        job = job_factory.create_job("j", csv_text, prefix=_prefix(image_dir))
+        assert len(job.items) == 1
+
+
+class TestJob:
+    def _job(self, image_dir):
+        return job_factory.create_job("j", CSV_BASIC, prefix=_prefix(image_dir))
+
+    def test_counts(self, image_dir):
+        job = self._job(image_dir)
+        job.items[0].set_state(m.WorkflowState.SUCCEEDED)
+        job.items[1].set_state(m.WorkflowState.FAILED)
+        assert job.remaining() == 0
+        assert len(job.failed_items()) == 1
+        assert len(job.succeeded_items()) == 1
+
+    def test_update_metadata_appends_columns(self, image_dir):
+        # reference: Job.java:230-315 — appends the state/URL columns
+        job = self._job(image_dir)
+        job.items[0].set_state(m.WorkflowState.SUCCEEDED)
+        job.items[0].access_url = "http://iiif/ark%3A%2F111%2Faaa"
+        job.items[1].set_state(m.WorkflowState.FAILED)
+        csv_out = job.update_metadata().to_csv()
+        lines = csv_out.strip().split("\n")
+        assert lines[0].endswith("Bucketeer State,IIIF Access URL")
+        assert "succeeded" in lines[1] and "http://iiif/" in lines[1]
+        assert "failed" in lines[2]
+
+    def test_update_metadata_fills_existing_columns(self, image_dir):
+        job = job_factory.create_job("j", CSV_SUBSEQUENT, subsequent_run=False,
+                                     prefix=_prefix(image_dir))
+        job.items[0].set_state(m.WorkflowState.SUCCEEDED)
+        csv_out = job.update_metadata().to_csv()
+        header = csv_out.split("\n")[0]
+        # No duplicate columns added
+        assert header.count("Bucketeer State") == 1
+        assert header.count("IIIF Access URL") == 1
+
+    def test_json_round_trip(self, image_dir):
+        # reference: Job.java:25,363-365 — jobs survive the shared map
+        job = self._job(image_dir)
+        job.items[0].set_state(m.WorkflowState.SUCCEEDED)
+        job.slack_handle = "someone"
+        blob = json.dumps(job.to_json())
+        restored = m.Job.from_json(json.loads(blob))
+        assert restored.name == job.name
+        assert restored.slack_handle == "someone"
+        assert restored.items[0].workflow_state is m.WorkflowState.SUCCEEDED
+        assert restored.items[0].get_file() == job.items[0].get_file()
+        assert restored.remaining() == job.remaining()
+
+
+class TestPathPrefix:
+    def test_generic(self):
+        p = pp.GenericFilePathPrefix("/mnt/images")
+        assert p.get_prefix("x/y.tif") == "/mnt/images"
+
+    def test_ucla_inserts_dlmasters(self):
+        # reference: UCLAFilePathPrefix.java:24-28,60-70
+        p = pp.UCLAFilePathPrefix("/mnt")
+        assert p.get_prefix("foo/bar.tif") == os.path.join(
+            "/mnt", "Masters", "dlmasters")
+        assert p.get_prefix("Masters/other.tif") == "/mnt"
+
+    def test_factory(self):
+        assert isinstance(pp.get_prefix("UCLAFilePathPrefix", "/m"),
+                          pp.UCLAFilePathPrefix)
+        assert isinstance(pp.get_prefix("ucla", "/m"), pp.UCLAFilePathPrefix)
+        assert isinstance(pp.get_prefix(None, "/m"), pp.GenericFilePathPrefix)
+        assert isinstance(pp.get_prefix("anything", "/m"),
+                          pp.GenericFilePathPrefix)
+
+    def test_json_round_trip(self):
+        p = pp.UCLAFilePathPrefix("/mnt")
+        restored = pp.from_json(p.to_json())
+        assert restored == p
