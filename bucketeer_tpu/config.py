@@ -48,6 +48,18 @@ TPU_LOSSY_RATE = "bucketeer.tpu.lossy.rate"          # bpp, kdu '-rate 3' analog
 TPU_BATCH_SIZE = "bucketeer.tpu.batch.size"          # vmap batch for CSV path
 TPU_MESH_SHAPE = "bucketeer.tpu.mesh.shape"          # e.g. "2x4" for v5e-8
 
+# Every known key (env overlay applies to these even without defaults).
+ALL_KEYS = (
+    HTTP_PORT, OPENAPI_SPEC_PATH, S3_ACCESS_KEY, S3_SECRET_KEY, S3_REGION,
+    S3_BUCKET, S3_ENDPOINT, LAMBDA_S3_BUCKET, IIIF_URL, LARGE_IMAGE_URL,
+    BATCH_CALLBACK_URL, FESTER_URL, THUMBNAIL_SIZE, MAX_SOURCE_SIZE,
+    S3_MAX_REQUESTS, S3_MAX_RETRIES, S3_REQUEUE_DELAY,
+    S3_UPLOADER_INSTANCES, S3_UPLOADER_THREADS, FILESYSTEM_IMAGE_MOUNT,
+    FILESYSTEM_CSV_MOUNT, FILESYSTEM_PREFIX, SLACK_OAUTH_TOKEN,
+    SLACK_CHANNEL_ID, SLACK_ERROR_CHANNEL_ID, SLACK_WEBHOOK_URL,
+    FEATURE_FLAGS, TPU_LOSSY_RATE, TPU_BATCH_SIZE, TPU_MESH_SHAPE,
+)
+
 _DEFAULTS: dict[str, Any] = {
     HTTP_PORT: 8888,                    # reference: MainVerticle.java:54
     MAX_SOURCE_SIZE: 300_000_000,       # reference: pom.xml:192-193
@@ -78,12 +90,12 @@ class Config:
             values.update(_parse_properties(path))
         # Environment overlay: either the exact key, or KEY with dots->underscores,
         # upper-cased (container style: BUCKETEER_S3_BUCKET).
-        for key in set(values) | set(_DEFAULTS):
+        for key in set(values) | set(ALL_KEYS):
             env_key = key.replace(".", "_").upper()
             if env_key in os.environ:
                 values[key] = os.environ[env_key]
         for k, v in os.environ.items():
-            if k in values or k in _DEFAULTS:  # exact-name env entries
+            if k in values or k in ALL_KEYS:  # exact-name env entries
                 values[k] = v
         if overrides:
             values.update(overrides)

@@ -1,0 +1,29 @@
+"""Async job engine: message bus, shared state, and workers.
+
+Replaces the reference's Vert.x verticle runtime + event bus (reference:
+src/main/java/edu/ucla/library/bucketeer/verticles/ — see SURVEY.md §1
+L2). Same request/reply + ``retry`` backpressure protocol, same shared
+state semantics, asyncio instead of an event-bus process."""
+from .batch import BATCH_CONVERTER, BatchConverterWorker, start_job
+from .bus import BusError, MessageBus, Reply
+from .core import Engine
+from .s3 import (FakeS3Client, HttpS3Client, S3_UPLOADER, S3Error,
+                 S3UploadWorker, S3UploaderConfig)
+from .slack import HttpSlackClient, RecordingSlackClient, SlackWorker
+from .store import Counters, JobStore, LockTimeout, UploadsMap
+from .workers import (FESTER, FINALIZE_JOB, IMAGE_WORKER, ITEM_FAILURE,
+                      LARGE_IMAGE, FesterWorker, FinalizeJobWorker,
+                      ImageWorker, ItemFailureWorker, LargeImageWorker,
+                      update_item_status)
+
+__all__ = [
+    "Engine", "MessageBus", "Reply", "BusError",
+    "JobStore", "Counters", "UploadsMap", "LockTimeout",
+    "FakeS3Client", "HttpS3Client", "S3Error", "S3UploadWorker",
+    "S3UploaderConfig", "S3_UPLOADER",
+    "SlackWorker", "HttpSlackClient", "RecordingSlackClient",
+    "ImageWorker", "ItemFailureWorker", "FinalizeJobWorker",
+    "LargeImageWorker", "FesterWorker", "update_item_status",
+    "IMAGE_WORKER", "ITEM_FAILURE", "FINALIZE_JOB", "LARGE_IMAGE", "FESTER",
+    "BatchConverterWorker", "BATCH_CONVERTER", "start_job",
+]

@@ -1,0 +1,152 @@
+"""Batch job dispatch + the in-process TPU batch converter.
+
+Port of the reference's batch orchestration (reference:
+handlers/LoadCsvHandler.java:237-314 ``startJob``) with the Lambda
+fan-out replaced by the local device mesh: instead of uploading source
+TIFFs to a "lambda" S3 bucket for an external converter fleet
+(reference: :256-263), items are queued to the in-process batch
+converter, which encodes on the TPU, uploads the derivative, and pushes
+the result through the *same* status-update seam the external Lambda
+would use (PATCH semantics; reference: BatchJobStatusHandler.java,
+SURVEY.md §7 layer 4). Setting ``bucketeer.batch.mode=lambda`` restores
+the reference's external flow: sources are uploaded to the lambda bucket
+and a real Lambda PATCHes statuses back.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from .. import config as cfg
+from .. import constants as c
+from .. import features
+from ..converters import Conversion, ConverterError
+from ..models import Job, WorkflowState
+from .bus import MessageBus, Reply
+from .s3 import S3_UPLOADER
+from .store import JobStore
+from .workers import (FINALIZE_JOB, ITEM_FAILURE, LARGE_IMAGE,
+                      update_item_status)
+
+LOG = logging.getLogger(__name__)
+
+BATCH_CONVERTER = "batch-converter"
+BATCH_MODE = "bucketeer.batch.mode"          # "tpu" (default) | "lambda"
+
+
+class BatchConverterWorker:
+    """The TPU stand-in for the kakadu-lambda-converter fleet: convert,
+    upload the derivative, report status through the shared seam."""
+
+    def __init__(self, converter, store: JobStore, bus: MessageBus,
+                 config) -> None:
+        self.converter = converter
+        self.store = store
+        self.bus = bus
+        self.config = config
+
+    def register(self, bus: MessageBus, instances: int = 2) -> None:
+        bus.consumer(BATCH_CONVERTER, self.handle, instances=instances)
+
+    async def handle(self, message: dict) -> Reply:
+        job_name = message[c.JOB_NAME]
+        image_id = message[c.IMAGE_ID]
+        file_path = message[c.FILE_PATH]
+        ok = False
+        try:
+            derivative = await asyncio.to_thread(
+                self.converter.convert, image_id, file_path,
+                Conversion.LOSSLESS)
+            reply = await self.bus.request_with_retry(S3_UPLOADER, {
+                c.IMAGE_ID: os.path.basename(derivative),
+                c.FILE_PATH: derivative,
+                c.JOB_NAME: job_name,
+                c.DERIVATIVE_IMAGE: True,
+            })
+            ok = reply.is_success
+        except ConverterError as exc:
+            LOG.error("batch convert failed for %s: %s", image_id, exc)
+        except Exception as exc:
+            LOG.exception("batch item %s errored: %s", image_id, exc)
+        try:
+            await update_item_status(
+                self.store, self.bus, job_name, image_id, ok,
+                self.config.get_str(cfg.IIIF_URL))
+        except KeyError:
+            LOG.warning("job %s vanished before item %s resolved",
+                        job_name, image_id)
+        return Reply.success() if ok else Reply.failure(
+            500, f"conversion failed for {image_id}")
+
+
+async def start_job(job: Job, bus: MessageBus, config,
+                    flags: features.FeatureFlagChecker) -> None:
+    """Dispatch every pending item of a queued job (reference:
+    LoadCsvHandler.java:237-314):
+
+    - within the size cap -> batch converter (or lambda-bucket upload in
+      ``lambda`` mode);
+    - oversized + large-images flag -> peer routing;
+    - oversized without the flag -> item FAILED;
+    - nothing runnable at all -> finalize immediately with
+      ``nothing-processed`` (reference: :309-313).
+    """
+    max_size = config.get_int(cfg.MAX_SOURCE_SIZE)
+    lambda_mode = (config.get_str(BATCH_MODE) or "tpu").lower() == "lambda"
+    large_ok = flags.is_enabled(features.LARGE_IMAGES)
+    dispatched = 0
+
+    for item in job.items:
+        if item.workflow_state != WorkflowState.EMPTY or not item.has_file():
+            continue
+        path = item.get_file()
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            await bus.send(ITEM_FAILURE,
+                           {c.JOB_NAME: job.name, c.IMAGE_ID: item.id})
+            dispatched += 1
+            continue
+
+        if size <= max_size:
+            if lambda_mode:
+                # Reference flow: push the source TIFF to the lambda
+                # bucket; the external converter PATCHes back
+                # (reference: LoadCsvHandler.java:256-263).
+                ext = os.path.splitext(path)[1]
+                reply = await bus.request_with_retry(S3_UPLOADER, {
+                    c.IMAGE_ID: item.id + ext,
+                    c.FILE_PATH: path,
+                    c.JOB_NAME: job.name,
+                    c.S3_BUCKET: config.get_str(cfg.LAMBDA_S3_BUCKET),
+                })
+                if not reply.is_success:
+                    await bus.send(ITEM_FAILURE, {c.JOB_NAME: job.name,
+                                                  c.IMAGE_ID: item.id})
+            else:
+                await bus.send(BATCH_CONVERTER, {
+                    c.JOB_NAME: job.name, c.IMAGE_ID: item.id,
+                    c.FILE_PATH: path,
+                })
+            dispatched += 1
+        elif large_ok:
+            # reference: LoadCsvHandler.java:270-281
+            reply = await bus.request_with_retry(LARGE_IMAGE, {
+                c.JOB_NAME: job.name, c.IMAGE_ID: item.id,
+                c.FILE_PATH: item.file_path,
+            })
+            if not reply.is_success:
+                await bus.send(ITEM_FAILURE, {c.JOB_NAME: job.name,
+                                              c.IMAGE_ID: item.id})
+            dispatched += 1
+        else:
+            # reference: LoadCsvHandler.java:284-288 — too big, no route
+            await bus.send(ITEM_FAILURE,
+                           {c.JOB_NAME: job.name, c.IMAGE_ID: item.id})
+            dispatched += 1
+
+    if dispatched == 0:
+        # reference: LoadCsvHandler.java:309-313
+        await bus.send(FINALIZE_JOB, {c.JOB_NAME: job.name,
+                                      c.NOTHING_PROCESSED: True})

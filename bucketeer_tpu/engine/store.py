@@ -1,0 +1,118 @@
+"""Shared state: job store, upload results, counters, locks.
+
+Port of the reference's Vert.x shared data (reference: SURVEY.md §1 state
+table): async map ``lambda-jobs`` (job-name -> Job) as the job queue
+(reference: Constants.java:145, handlers/LoadCsvHandler.java:185), local
+map ``s3-uploads`` of completed uploads (S3BucketVerticle.java:171),
+shared counters (``s3-request-count``, per-image retry counters,
+S3BucketVerticle.java:89,251), and a ``job-lock`` with a 10 s acquisition
+timeout guarding job mutation (Constants.java:44-49,
+handlers/BatchJobStatusHandler.java:115-127).
+
+Single-process asyncio: plain dicts + one asyncio.Lock give the same
+guarantees the single-node Vert.x shared data gave the reference.
+"""
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from collections import defaultdict
+
+from .. import constants
+from ..models import Job, JobNotFoundError
+
+
+class LockTimeout(TimeoutError):
+    """Could not acquire the job lock within the timeout (reference:
+    BatchJobStatusHandler.java:115-127 fails the request on lock
+    timeout)."""
+
+
+class JobStore:
+    """The ``lambda-jobs`` map + job lock."""
+
+    def __init__(self,
+                 lock_timeout: float = constants.JOB_LOCK_TIMEOUT) -> None:
+        self._jobs: dict[str, Job] = {}
+        self._lock = asyncio.Lock()
+        self.lock_timeout = lock_timeout
+
+    @contextlib.asynccontextmanager
+    async def locked(self, timeout: float | None = None):
+        """The job mutation lock (reference: Constants.java:44-49)."""
+        try:
+            await asyncio.wait_for(self._lock.acquire(),
+                                   timeout or self.lock_timeout)
+        except asyncio.TimeoutError:
+            raise LockTimeout(
+                f"job-lock not acquired in {timeout or self.lock_timeout}s")
+        try:
+            yield self
+        finally:
+            self._lock.release()
+
+    def put(self, job: Job) -> None:
+        self._jobs[job.name] = job
+
+    def get(self, name: str) -> Job:
+        try:
+            return self._jobs[name]
+        except KeyError:
+            raise JobNotFoundError(name)
+
+    def maybe_get(self, name: str) -> Job | None:
+        return self._jobs.get(name)
+
+    def remove(self, name: str) -> Job:
+        try:
+            return self._jobs.pop(name)
+        except KeyError:
+            raise JobNotFoundError(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._jobs)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._jobs
+
+    def __len__(self) -> int:
+        return len(self._jobs)
+
+
+class Counters:
+    """Shared counters: global in-flight S3 requests + per-image retry
+    counts (reference: S3BucketVerticle.java:89-99,219-277)."""
+
+    def __init__(self) -> None:
+        self._values: dict[str, int] = defaultdict(int)
+
+    def increment(self, name: str) -> int:
+        self._values[name] += 1
+        return self._values[name]
+
+    def decrement(self, name: str) -> int:
+        self._values[name] -= 1
+        return self._values[name]
+
+    def get(self, name: str) -> int:
+        return self._values[name]
+
+    def reset(self, name: str) -> None:
+        self._values.pop(name, None)
+
+
+class UploadsMap:
+    """Completed-upload records (reference: S3BucketVerticle.java:168-175
+    stores per-image success entries in the ``s3-uploads`` local map)."""
+
+    def __init__(self) -> None:
+        self._records: dict[str, dict] = {}
+
+    def record(self, image_id: str, details: dict) -> None:
+        self._records[image_id] = details
+
+    def get(self, image_id: str) -> dict | None:
+        return self._records.get(image_id)
+
+    def __len__(self) -> int:
+        return len(self._records)

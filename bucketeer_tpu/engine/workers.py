@@ -1,0 +1,336 @@
+"""Engine workers: the async ports of the reference's verticles.
+
+- :class:`ImageWorker` — single-image conversion
+  (reference: verticles/ImageWorkerVerticle.java:54-155);
+- :func:`update_item_status` — the shared status-update seam used by both
+  the PATCH endpoint and in-process converters
+  (reference: handlers/BatchJobStatusHandler.java:115-197);
+- :class:`ItemFailureWorker` — mark an item failed under the job lock
+  (reference: verticles/ItemFailureVerticle.java:54-152);
+- :class:`FinalizeJobWorker` — job completion: metadata update, CSV
+  write, Slack notification
+  (reference: verticles/FinalizeJobVerticle.java:66-311);
+- :class:`LargeImageWorker` — route oversized images to a peer instance
+  (reference: verticles/LargeImageVerticle.java:59-97);
+- :class:`FesterWorker` — POST the finished CSV to a IIIF-manifest
+  service (reference: verticles/FesterVerticle.java:68-104; dead code
+  there, flag-gated here).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import urllib.parse
+
+from .. import config as cfg
+from .. import constants as c
+from .. import features
+from ..converters import Conversion, ConverterError
+from ..models import WorkflowState
+from .bus import MessageBus, Reply
+from .s3 import S3_UPLOADER
+from .slack import (CSV_DATA, SLACK, SLACK_CHANNEL_ID, SLACK_MESSAGE_TEXT)
+from .store import JobStore, LockTimeout
+
+LOG = logging.getLogger(__name__)
+
+IMAGE_WORKER = "image-worker"
+ITEM_FAILURE = "item-failure"
+FINALIZE_JOB = "finalize-job"
+LARGE_IMAGE = "large-image"
+FESTER = "fester"
+
+
+class ImageWorker:
+    """Single-image conversion worker. Mirrors the reference's sequencing:
+    reply ``success`` as soon as the convert finishes (the HTTP 201 goes
+    out before the upload), then upload the derivative and PATCH the
+    callback URL with the outcome (reference:
+    ImageWorkerVerticle.java:58-105)."""
+
+    def __init__(self, converter, bus: MessageBus,
+                 http_client=None) -> None:
+        self.converter = converter
+        self.bus = bus
+        self.http_client = http_client     # async (method,url)->status
+        self.background: set[asyncio.Task] = set()
+
+    def register(self, bus: MessageBus, instances: int = 1) -> None:
+        # Reference deploys exactly one single-threaded image worker
+        # (MainVerticle.java:229-231); instances are configurable here.
+        bus.consumer(IMAGE_WORKER, self.handle, instances=instances)
+
+    async def handle(self, message: dict) -> Reply:
+        image_id = message[c.IMAGE_ID]
+        file_path = message[c.FILE_PATH]
+        callback_url = message.get(c.CALLBACK_URL)
+        try:
+            derivative = await asyncio.to_thread(
+                self.converter.convert, image_id, file_path,
+                Conversion.LOSSLESS)
+        except ConverterError as exc:
+            if callback_url:
+                await self._patch_callback(callback_url, False)
+            return Reply.failure(500, str(exc))
+        # Upload happens after the success reply (reference: :71-72 replies
+        # before requesting the upload).
+        task = asyncio.create_task(
+            self._upload(image_id, derivative, callback_url))
+        self.background.add(task)
+        task.add_done_callback(self.background.discard)
+        return Reply.success({c.IMAGE_ID: image_id, c.FILE_PATH: file_path})
+
+    async def _upload(self, image_id: str, derivative: str,
+                      callback_url: str | None) -> None:
+        jpx_name = os.path.basename(derivative)
+        reply = await self.bus.request_with_retry(S3_UPLOADER, {
+            c.IMAGE_ID: urllib.parse.unquote(os.path.splitext(jpx_name)[0])
+            + os.path.splitext(jpx_name)[1],
+            c.FILE_PATH: derivative,
+            c.DERIVATIVE_IMAGE: True,
+        })
+        if callback_url:
+            await self._patch_callback(callback_url, reply.is_success)
+
+    async def _patch_callback(self, url: str, ok: bool) -> None:
+        """PATCH callback-url + '/true'|'/false' (reference:
+        ImageWorkerVerticle.java:76-101)."""
+        full = url.rstrip("/") + ("/true" if ok else "/false")
+        try:
+            if self.http_client is not None:
+                await self.http_client("PATCH", full)
+            else:
+                import aiohttp
+                async with aiohttp.ClientSession() as session:
+                    async with session.patch(full) as resp:
+                        await resp.read()
+        except Exception as exc:
+            LOG.error("callback PATCH %s failed: %s", full, exc)
+
+
+async def update_item_status(store: JobStore, bus: MessageBus,
+                             job_name: str, image_id: str, success: bool,
+                             iiif_url: str | None) -> bool:
+    """Set one item's terminal state under the job lock and finalize the
+    job when nothing is left (the PATCH endpoint's core, also called by
+    the in-process batch converter — the same seam the reference exposes
+    to its Lambda; reference: BatchJobStatusHandler.java:115-197).
+
+    Returns True when this update completed the job.
+    """
+    async with store.locked():
+        job = store.get(job_name)          # raises JobNotFoundError
+        item = job.find_item(image_id)
+        if item is None:
+            raise KeyError(f"item {image_id} not in job {job_name}")
+        if success:
+            item.set_state(WorkflowState.SUCCEEDED)
+            if iiif_url:
+                # IIIF access URL = iiif.url + URL-encoded id (reference:
+                # BatchJobStatusHandler.java:162-170).
+                item.access_url = iiif_url.rstrip("/") + "/" + \
+                    urllib.parse.quote(image_id, safe="")
+        else:
+            item.set_state(WorkflowState.FAILED)
+        finished = job.remaining() == 0
+    if finished:
+        await bus.send(FINALIZE_JOB, {c.JOB_NAME: job_name})
+    return finished
+
+
+class ItemFailureWorker:
+    """Marks an item FAILED under the lock; finalizes when no EMPTY items
+    remain (reference: verticles/ItemFailureVerticle.java:54-152)."""
+
+    def __init__(self, store: JobStore, bus: MessageBus) -> None:
+        self.store = store
+        self.bus = bus
+
+    def register(self, bus: MessageBus) -> None:
+        bus.consumer(ITEM_FAILURE, self.handle)
+
+    async def handle(self, message: dict) -> Reply:
+        job_name = message[c.JOB_NAME]
+        image_id = message[c.IMAGE_ID]
+        try:
+            await update_item_status(self.store, self.bus, job_name,
+                                     image_id, False, None)
+        except LockTimeout as exc:
+            return Reply.failure(503, str(exc))
+        except KeyError as exc:
+            return Reply.failure(404, str(exc))
+        return Reply.success()
+
+
+class FinalizeJobWorker:
+    """Job completion: pop the job, bake states into the CSV, optionally
+    write it to the CSV mount (feature-flagged), and notify Slack
+    (reference: verticles/FinalizeJobVerticle.java:66-181)."""
+
+    def __init__(self, store: JobStore, bus: MessageBus, config,
+                 flags: features.FeatureFlagChecker) -> None:
+        self.store = store
+        self.bus = bus
+        self.config = config
+        self.flags = flags
+
+    def register(self, bus: MessageBus) -> None:
+        bus.consumer(FINALIZE_JOB, self.handle)
+
+    async def handle(self, message: dict) -> Reply:
+        job_name = message[c.JOB_NAME]
+        nothing_processed = bool(message.get(c.NOTHING_PROCESSED))
+        try:
+            async with self.store.locked():
+                job = self.store.remove(job_name)
+        except KeyError:
+            return Reply.failure(404, f"job not found: {job_name}")
+
+        job.update_metadata()
+        csv_text = job.to_csv()
+
+        reply_op_failure = None
+        if self.flags.is_enabled(features.FS_WRITE_CSV):
+            # Write the final CSV to the mount (reference: :84-121).
+            mount = self.config.get_str(cfg.FILESYSTEM_CSV_MOUNT) or "."
+            try:
+                os.makedirs(mount, exist_ok=True)
+                path = os.path.join(mount, f"{job_name}.csv")
+                with open(path, "w", encoding="utf-8") as fh:
+                    fh.write(csv_text)
+                LOG.info("wrote job CSV to %s", path)
+            except OSError as exc:
+                LOG.error("CSV write failed: %s", exc)
+                reply_op_failure = str(exc)
+
+        await self._notify_slack(job, csv_text, nothing_processed)
+        if reply_op_failure:
+            # reference: Op.java:42 fs-write-csv-failure reply
+            return Reply(op="fs-write-csv-failure",
+                         message=reply_op_failure)
+        return Reply.success()
+
+    async def _notify_slack(self, job, csv_text: str,
+                            nothing_processed: bool) -> None:
+        channel = self.config.get_str(cfg.SLACK_CHANNEL_ID) or "dev-null"
+        handle = job.slack_handle or "there"
+        if nothing_processed:
+            text = (f"Hi @{handle}! Your job '{job.name}' had nothing to "
+                    "process (all items were already handled or failed "
+                    "up front).")
+        else:
+            # Summary: items/failed/missing + IIIF host (reference:
+            # FinalizeJobVerticle.java:143-157,279-311).
+            iiif = self.config.get_str(cfg.IIIF_URL) or ""
+            text = (f"Hi @{handle}! Your batch job '{job.name}' is done: "
+                    f"{len(job.items)} item(s), "
+                    f"{len(job.failed_items())} failed, "
+                    f"{len(job.missing_items())} missing."
+                    + (f" Images will appear under {iiif}." if iiif else ""))
+        try:
+            await self.bus.request(SLACK, {
+                SLACK_CHANNEL_ID: channel,
+                SLACK_MESSAGE_TEXT: text,
+                CSV_DATA: csv_text,
+                c.JOB_NAME: job.name,
+            })
+        except Exception as exc:
+            LOG.error("slack notify failed: %s", exc)
+            error_channel = self.config.get_str(cfg.SLACK_ERROR_CHANNEL_ID)
+            if error_channel:
+                try:
+                    await self.bus.request(SLACK, {
+                        SLACK_CHANNEL_ID: error_channel,
+                        SLACK_MESSAGE_TEXT:
+                            f"Failed to deliver results for job "
+                            f"'{job.name}': {exc}",
+                    })
+                except Exception:
+                    pass
+
+
+class LargeImageWorker:
+    """Route images too big for the in-process batch path to a peer
+    instance's single-image endpoint with a double-URL-encoded callback
+    (reference: verticles/LargeImageVerticle.java:72-97)."""
+
+    def __init__(self, config, bus: MessageBus, http_client=None) -> None:
+        self.config = config
+        self.bus = bus
+        self.http_client = http_client     # async (method,url)->status
+
+    def register(self, bus: MessageBus) -> None:
+        bus.consumer(LARGE_IMAGE, self.handle)
+
+    async def handle(self, message: dict) -> Reply:
+        job_name = message[c.JOB_NAME]
+        image_id = message[c.IMAGE_ID]
+        file_path = message[c.FILE_PATH]
+        base = self.config.get_str(cfg.LARGE_IMAGE_URL)
+        callback_tmpl = self.config.get_str(cfg.BATCH_CALLBACK_URL)
+        if not base or not callback_tmpl:
+            return Reply.failure(
+                500, "large-image routing not configured "
+                     f"({cfg.LARGE_IMAGE_URL}/{cfg.BATCH_CALLBACK_URL})")
+        callback = callback_tmpl.replace(
+            "{}", urllib.parse.quote(job_name, safe=""), 1).replace(
+            "{}", urllib.parse.quote(image_id, safe=""), 1)
+        # Double-encode: the peer URL-decodes once in routing (reference:
+        # LargeImageVerticle.java:72-84).
+        url = (f"{base.rstrip('/')}/images/"
+               f"{urllib.parse.quote(image_id, safe='')}/"
+               f"{urllib.parse.quote(file_path, safe='')}"
+               f"?callback-url={urllib.parse.quote(callback, safe='')}")
+        try:
+            if self.http_client is not None:
+                status = await self.http_client("GET", url)
+            else:
+                import aiohttp
+                async with aiohttp.ClientSession() as session:
+                    async with session.get(url) as resp:
+                        status = resp.status
+        except Exception as exc:
+            return Reply.failure(502, f"peer unreachable: {exc}")
+        if status != 201:
+            return Reply.failure(status, f"peer returned {status}")
+        return Reply.success()
+
+
+class FesterWorker:
+    """POST the finished CSV to the Fester IIIF-manifest service as
+    multipart (reference: verticles/FesterVerticle.java:68-104 — deployed
+    but unused there; implemented and flag-free here, invoked only when
+    ``bucketeer.fester.url`` is configured)."""
+
+    def __init__(self, config, http_post=None) -> None:
+        self.config = config
+        self.http_post = http_post     # async (url, field, filename, data)
+
+    def register(self, bus: MessageBus) -> None:
+        bus.consumer(FESTER, self.handle)
+
+    async def handle(self, message: dict) -> Reply:
+        url = self.config.get_str(cfg.FESTER_URL)
+        if not url:
+            return Reply.failure(500, "fester url not configured")
+        csv_text = message[CSV_DATA]
+        job_name = message.get(c.JOB_NAME, "job")
+        try:
+            if self.http_post is not None:
+                await self.http_post(url, "file", f"{job_name}.csv", csv_text)
+            else:
+                import aiohttp
+                form = aiohttp.FormData()
+                form.add_field("file", csv_text,
+                               filename=f"{job_name}.csv",
+                               content_type="text/csv")
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                            url.rstrip("/") + "/collections", data=form) \
+                            as resp:
+                        if resp.status >= 400:
+                            raise RuntimeError(f"fester {resp.status}")
+        except Exception as exc:
+            return Reply.failure(502, str(exc))
+        return Reply.success()

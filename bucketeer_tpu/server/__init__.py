@@ -1,0 +1,6 @@
+"""HTTP API layer + web UI (reference: SURVEY.md §1 L3/L4 — the
+OpenAPI-contract router, handlers, and static webroot)."""
+from .app import build_app
+from .metrics import Metrics
+
+__all__ = ["build_app", "Metrics"]

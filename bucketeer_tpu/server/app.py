@@ -1,0 +1,335 @@
+"""HTTP API: the 8 OpenAPI operations + static web UI.
+
+Port of the reference's contract-driven router and handlers (reference:
+verticles/MainVerticle.java:110-163 builds an OpenAPI3 router from
+bucketeer.yaml and binds handlers by operationId; handlers/ implements
+them). Same paths, operationIds, status codes, and payload shapes — the
+contract lives in ``bucketeer_tpu/server/openapi.yaml`` and is served at
+``/docs/openapi.yaml``.
+
+Router quirks kept for parity:
+- ``/upload`` redirects to the CSV upload form
+  (reference: MainVerticle.java:143-158);
+- non-PATCH methods on the batch status-update path return 405, not 404
+  (reference: handlers/MatchingOpNotFoundHandler.java:28-47);
+- validation failures render the HTML error template with 400, unexpected
+  errors 500 (reference: handlers/FailureHandler.java:57-95).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import re
+import urllib.parse
+
+from aiohttp import web
+
+from .. import config as cfg
+from .. import constants as c
+from .. import job_factory
+from .. import models as m
+from ..converters import available_converters
+from ..engine import Engine, start_job, update_item_status
+from ..engine.store import LockTimeout
+from ..engine.workers import IMAGE_WORKER
+from ..utils import path_prefix as pp
+from . import metrics as metrics_mod
+
+LOG = logging.getLogger(__name__)
+
+WEBROOT = os.path.join(os.path.dirname(__file__), "webroot")
+# reference: MatchingOpNotFoundHandler.java:28 — the status-update URL
+STATUS_UPDATE_RE = re.compile(r"^/batch/jobs/[^/]+/[^/]+/(?:true|false)$")
+
+
+def _html(template: str, **kw) -> str:
+    path = os.path.join(WEBROOT, template)
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    for key, value in kw.items():
+        text = text.replace("{{" + key + "}}", str(value))
+    return text
+
+
+def _error_page(status: int, message: str) -> web.Response:
+    # reference: FailureHandler.java:57-95 renders error.html
+    return web.Response(status=status, content_type="text/html",
+                        text=_html("error.html", status=status,
+                                   message=message))
+
+
+class Api:
+    """The handler set, bound to an :class:`Engine`."""
+
+    def __init__(self, engine: Engine) -> None:
+        self.engine = engine
+        self.metrics = metrics_mod.Metrics()
+        self._background: set[asyncio.Task] = set()
+        # Image-mount path prefix (reference: MainVerticle.java:92-102
+        # installs it on the JobFactory at boot).
+        self.prefix = pp.get_prefix(
+            engine.config.get_str(cfg.FILESYSTEM_PREFIX),
+            engine.config.get_str(cfg.FILESYSTEM_IMAGE_MOUNT) or "")
+
+    # --- getStatus (reference: handlers/GetStatusHandler.java:30-46) ---
+    async def get_status(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "status": "ok",
+            "features": self.engine.flags.report(),
+        })
+
+    # --- getConfig (reference: handlers/GetConfigHandler.java:33-77) ---
+    async def get_config(self, request: web.Request) -> web.Response:
+        config = self.engine.config
+        return web.json_response({
+            cfg.IIIF_URL: config.get_str(cfg.IIIF_URL),
+            cfg.FILESYSTEM_IMAGE_MOUNT:
+                config.get_str(cfg.FILESYSTEM_IMAGE_MOUNT),
+            cfg.FILESYSTEM_CSV_MOUNT:
+                config.get_str(cfg.FILESYSTEM_CSV_MOUNT),
+            cfg.S3_BUCKET: config.get_str(cfg.S3_BUCKET),
+            cfg.LAMBDA_S3_BUCKET: config.get_str(cfg.LAMBDA_S3_BUCKET),
+            cfg.S3_REGION: config.get_str(cfg.S3_REGION),
+            cfg.THUMBNAIL_SIZE: config.get_str(cfg.THUMBNAIL_SIZE),
+            cfg.MAX_SOURCE_SIZE: config.get_int(cfg.MAX_SOURCE_SIZE),
+            "converters": available_converters(),
+        })
+
+    # --- loadImage (reference: handlers/LoadImageHandler.java:35-96) ---
+    async def load_image(self, request: web.Request) -> web.Response:
+        image_id = urllib.parse.unquote(request.match_info["image_id"])
+        file_path = urllib.parse.unquote(request.match_info["file_path"])
+        callback_url = request.query.get(c.CALLBACK_URL)
+        if not image_id or not file_path:
+            return _error_page(400, "image-id and file-path are required")
+        if not file_path.startswith("/"):
+            file_path = "/" + file_path
+        exists = await asyncio.to_thread(os.path.exists, file_path)
+        if not exists:
+            return _error_page(404, f"source not found: {file_path}")
+        message = {c.IMAGE_ID: image_id, c.FILE_PATH: file_path}
+        if callback_url:
+            message[c.CALLBACK_URL] = callback_url
+        with self.metrics.time("single_image"):
+            reply = await self.engine.bus.request_with_retry(
+                IMAGE_WORKER, message)
+        if not reply.is_success:
+            return _error_page(500, reply.message or "conversion failed")
+        # 201 + JSON echo (reference: LoadImageHandler.java:73-75)
+        return web.json_response(
+            {c.IMAGE_ID: image_id, c.FILE_PATH: file_path}, status=201)
+
+    # --- loadImagesFromCSV (reference: handlers/LoadCsvHandler.java:100-230) ---
+    async def load_csv(self, request: web.Request) -> web.Response:
+        reader = await request.multipart() if request.content_type.startswith(
+            "multipart/") else None
+        slack_handle = None
+        csv_bytes = None
+        csv_name = "job"
+        subsequent = False
+        if reader is None:
+            return _error_page(400, "multipart form upload required")
+        async for part in reader:
+            if part.name == c.SLACK_HANDLE:
+                slack_handle = (await part.text()).strip()
+            elif part.name == c.CSV_FILE_UPLOAD:
+                csv_name = os.path.splitext(
+                    os.path.basename(part.filename or "job"))[0]
+                csv_bytes = await part.read(decode=True)
+            elif part.name == c.FAILURES:
+                subsequent = (await part.text()).strip().lower() in (
+                    "true", "on", "yes", "1")
+        # Validation (reference: LoadCsvHandler.java:105-124)
+        if not slack_handle:
+            return _error_page(400, "missing required slack-handle")
+        if not csv_bytes:
+            return _error_page(400, "missing required CSV upload")
+
+        job_name = csv_name
+        # Duplicate running job -> 429 (reference: :190-202)
+        async with self.engine.store.locked():
+            if job_name in self.engine.store:
+                return _error_page(
+                    429, f"batch job '{job_name}' is already running")
+            try:
+                job = job_factory.create_job(
+                    job_name, csv_bytes.decode("utf-8", errors="replace"),
+                    subsequent_run=subsequent, prefix=self.prefix)
+                warnings: list[str] = []
+            except job_factory.JobCreationWarnings as warn:
+                job = warn.job
+                warnings = warn.errors.messages
+            except m.ProcessingException as exc:
+                return _error_page(400, "; ".join(exc.messages))
+            job.slack_handle = slack_handle
+            self.engine.store.put(job)
+
+        # Respond first, then start the work (reference: :226-230 sends
+        # the success page before dispatching items).
+        task = asyncio.create_task(self._start_job(job))
+        self._background.add(task)
+        task.add_done_callback(self._background.discard)
+        return web.Response(
+            content_type="text/html",
+            text=_html("success.html", job=job_name,
+                       count=len(job.items),
+                       warnings="<br>".join(warnings)))
+
+    async def _start_job(self, job: m.Job) -> None:
+        try:
+            with self.metrics.time("batch_dispatch"):
+                await start_job(job, self.engine.bus, self.engine.config,
+                                self.engine.flags)
+        except Exception:
+            LOG.exception("start_job failed for %s", job.name)
+
+    # --- updateBatchJob (reference: handlers/BatchJobStatusHandler.java:56-197) ---
+    async def update_batch_job(self, request: web.Request) -> web.Response:
+        job_name = urllib.parse.unquote(request.match_info["job_name"])
+        image_id = urllib.parse.unquote(request.match_info["image_id"])
+        success = request.match_info["success"] == "true"
+        try:
+            await update_item_status(
+                self.engine.store, self.engine.bus, job_name, image_id,
+                success, self.engine.config.get_str(cfg.IIIF_URL))
+        except m.JobNotFoundError:
+            return _error_page(404, f"job not found: {job_name}")
+        except KeyError:
+            return _error_page(404, f"item not found: {image_id}")
+        except LockTimeout as exc:
+            return _error_page(503, str(exc))
+        return web.Response(status=204)
+
+    # --- getJobs (reference: handlers/GetJobsHandler.java:31-60) ---
+    async def get_jobs(self, request: web.Request) -> web.Response:
+        names = self.engine.store.names()
+        return web.json_response({c.COUNT: len(names), c.JOBS: names})
+
+    # --- getJobStatuses (reference: handlers/GetJobStatusesHandler.java:32-100) ---
+    async def get_job_statuses(self, request: web.Request) -> web.Response:
+        job_name = urllib.parse.unquote(request.match_info["job_name"])
+        job = self.engine.store.maybe_get(job_name)
+        if job is None:
+            return _error_page(404, f"job not found: {job_name}")
+        return web.json_response({
+            c.COUNT: len(job.items),
+            c.SLACK_HANDLE: job.slack_handle,
+            c.REMAINING: job.remaining(),
+            c.JOBS: [{
+                c.IMAGE_ID: item.id,
+                c.STATUS: str(item.workflow_state),
+                c.FILE_PATH: item.file_path,
+            } for item in job.items],
+        })
+
+    # --- deleteJob (reference: handlers/DeleteJobHandler.java:32-120) ---
+    async def delete_job(self, request: web.Request) -> web.Response:
+        job_name = urllib.parse.unquote(request.match_info["job_name"])
+        job = self.engine.store.maybe_get(job_name)
+        if job is None:
+            return _error_page(404, f"job not found: {job_name}")
+        before = job.remaining()
+        # Liveness probe: only delete if no progress during the wait
+        # (reference: DeleteJobHandler.java:90-120, 5 s).
+        await asyncio.sleep(float(request.app.get(
+            "job-delete-timeout", c.JOB_DELETE_TIMEOUT)))
+        job = self.engine.store.maybe_get(job_name)
+        if job is None:
+            return _error_page(404, f"job not found: {job_name}")
+        if job.remaining() != before:
+            return _error_page(
+                400, f"job '{job_name}' is still processing")
+        async with self.engine.store.locked():
+            self.engine.store.remove(job_name)
+        return web.Response(status=204)
+
+    # --- metrics (new: SURVEY.md §5 says the reference has none) ---
+    async def get_metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(self.metrics.report())
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except web.HTTPNotFound:
+        # 404 -> 405 rewrite for wrong-method hits on the status-update
+        # URL (reference: MatchingOpNotFoundHandler.java:31-47).
+        if (STATUS_UPDATE_RE.match(request.path)
+                and request.method != "PATCH"):
+            return _error_page(405, "use PATCH for batch status updates")
+        return _error_page(404, f"not found: {request.path}")
+    except web.HTTPException:
+        raise
+    except Exception as exc:
+        LOG.exception("unhandled error on %s", request.path)
+        return _error_page(500, f"internal error: {exc}")
+
+
+def build_app(engine: Engine,
+              job_delete_timeout: float | None = None) -> web.Application:
+    """Assemble the aiohttp application (reference:
+    MainVerticle.java:110-163)."""
+    api = Api(engine)
+    app = web.Application(middlewares=[error_middleware],
+                          client_max_size=512 * 1024 * 1024)
+    app["api"] = api
+    app["engine"] = engine
+    if job_delete_timeout is not None:
+        app["job-delete-timeout"] = job_delete_timeout
+
+    app.router.add_get("/status", api.get_status)
+    app.router.add_get("/config", api.get_config)
+    app.router.add_get("/images/{image_id}/{file_path:.+}", api.load_image)
+    app.router.add_post("/batch/input/csv", api.load_csv)
+    app.router.add_patch(
+        "/batch/jobs/{job_name}/{image_id:.+}/{success:(?:true|false)}",
+        api.update_batch_job)
+    app.router.add_get("/batch/jobs", api.get_jobs)
+    app.router.add_get("/batch/jobs/{job_name}", api.get_job_statuses)
+    app.router.add_delete("/batch/jobs/{job_name}", api.delete_job)
+    app.router.add_get("/metrics", api.get_metrics)
+
+    # Static web UI (reference: src/main/webroot; MainVerticle.java:143-158)
+    async def upload_redirect(request):
+        raise web.HTTPFound("/upload/csv/index.html")
+
+    async def index(request):
+        return web.Response(content_type="text/html",
+                            text=_html("index.html"))
+
+    async def upload_form(request):
+        return web.Response(content_type="text/html",
+                            text=_html("upload/csv/index.html"))
+
+    async def docs(request):
+        return web.Response(content_type="text/html",
+                            text=_html("docs/index.html"))
+
+    async def openapi_spec(request):
+        spec = os.path.join(os.path.dirname(__file__), "openapi.yaml")
+        with open(spec, "r", encoding="utf-8") as fh:
+            return web.Response(content_type="application/yaml",
+                                text=fh.read())
+
+    app.router.add_get("/", index)
+    app.router.add_get("/index.html", index)
+    app.router.add_get("/upload", upload_redirect)
+    app.router.add_get("/upload/", upload_redirect)
+    app.router.add_get("/upload/csv/", upload_form)
+    app.router.add_get("/upload/csv/index.html", upload_form)
+    app.router.add_get("/docs", docs)
+    app.router.add_get("/docs/", docs)
+    app.router.add_get("/docs/openapi.yaml", openapi_spec)
+
+    async def on_startup(app):
+        await engine.start()
+
+    async def on_cleanup(app):
+        await engine.close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app

@@ -1,0 +1,47 @@
+"""Server entry point: ``python -m bucketeer_tpu.server.main``.
+
+Boot sequence port (reference: verticles/MainVerticle.java:83-166 — load
+config, install the JobFactory path prefix, build the router, listen).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+from aiohttp import web
+
+from .. import config as cfg
+from .. import job_factory
+from ..engine import Engine
+from ..utils import path_prefix as pp
+from .app import build_app
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="Bucketeer TPU server")
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--config", default=None,
+                        help="properties file (or set BUCKETEER_CONFIG)")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    config = cfg.Config.load(args.config)
+    port = args.port or config.get_int(cfg.HTTP_PORT)
+
+    # Install the image-mount path prefix (reference:
+    # MainVerticle.java:92-102).
+    mount = config.get_str(cfg.FILESYSTEM_IMAGE_MOUNT) or ""
+    prefix_name = config.get_str(cfg.FILESYSTEM_PREFIX)
+    job_factory.set_path_prefix(pp.get_prefix(prefix_name, mount))
+
+    engine = Engine(config)
+    app = build_app(engine)
+    web.run_app(app, port=port)
+
+
+if __name__ == "__main__":
+    main()

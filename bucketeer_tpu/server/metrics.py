@@ -1,0 +1,60 @@
+"""Per-stage timing metrics.
+
+New relative to the reference — it has no metrics endpoint (SURVEY.md §5:
+"No Prometheus/metrics endpoint"); the TPU build reports MPixels/s per
+stage because throughput is the product metric."""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class StageStats:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+    pixels: int = 0
+
+    def record(self, seconds: float, pixels: int = 0) -> None:
+        self.count += 1
+        self.total_s += seconds
+        self.max_s = max(self.max_s, seconds)
+        self.pixels += pixels
+
+
+@dataclass
+class Metrics:
+    stages: dict = field(default_factory=lambda: defaultdict(StageStats))
+    started_at: float = field(default_factory=time.time)
+
+    @contextlib.contextmanager
+    def time(self, stage: str, pixels: int = 0):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.stages[stage].record(time.perf_counter() - t0, pixels)
+
+    def record(self, stage: str, seconds: float, pixels: int = 0) -> None:
+        self.stages[stage].record(seconds, pixels)
+
+    def report(self) -> dict:
+        out = {"uptime_s": round(time.time() - self.started_at, 1),
+               "stages": {}}
+        for name, st in sorted(self.stages.items()):
+            entry = {
+                "count": st.count,
+                "total_s": round(st.total_s, 3),
+                "mean_s": round(st.total_s / st.count, 4) if st.count else 0,
+                "max_s": round(st.max_s, 3),
+            }
+            if st.pixels:
+                entry["mpixels"] = round(st.pixels / 1e6, 2)
+                if st.total_s > 0:
+                    entry["mpixels_per_s"] = round(
+                        st.pixels / 1e6 / st.total_s, 2)
+            out["stages"][name] = entry
+        return out

@@ -27,6 +27,9 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Async HTTP-API tests (tests/test_api.py) run on aiohttp's pytest plugin.
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
 
 @pytest.fixture(scope="session")
 def rng():

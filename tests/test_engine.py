@@ -1,0 +1,482 @@
+"""Job-engine tests: bus protocol, S3 uploader semantics, status-update
+seam, finalize flow, batch dispatch.
+
+Ports the reference's verticle test coverage (reference:
+src/test/java/.../verticles/S3BucketVerticleTest.java,
+ItemFailureVerticleTest, FinalizeJobVerticleTest,
+utils/FilesystemWriteCsvFfOnT.java — the mocked-Lambda e2e) onto the
+asyncio engine, using the fake S3 client and a stub converter the way
+the reference uses FakeS3BucketVerticle and the fake-lambda script.
+"""
+import asyncio
+import os
+
+import pytest
+
+from bucketeer_tpu import config as cfg
+from bucketeer_tpu import constants as c
+from bucketeer_tpu import features, job_factory
+from bucketeer_tpu import models as m
+from bucketeer_tpu.converters import ConverterError
+from bucketeer_tpu.engine import (BATCH_CONVERTER, BatchConverterWorker,
+                                  Counters, FakeS3Client, FinalizeJobWorker,
+                                  ImageWorker, ItemFailureWorker, JobStore,
+                                  MessageBus, RecordingSlackClient, Reply,
+                                  S3UploadWorker, S3UploaderConfig,
+                                  S3_UPLOADER, SlackWorker, UploadsMap,
+                                  start_job, update_item_status)
+from bucketeer_tpu.engine.slack import SLACK
+from bucketeer_tpu.engine.workers import FINALIZE_JOB, ITEM_FAILURE
+from bucketeer_tpu.utils import path_prefix as pp
+
+
+class StubConverter:
+    """Instant 'conversion': writes a marker derivative file."""
+
+    def __init__(self, tmpdir, fail_ids=()):
+        self.tmpdir = str(tmpdir)
+        self.fail_ids = set(fail_ids)
+        self.converted = []
+
+    def convert(self, image_id, source_path, conversion=None):
+        if image_id in self.fail_ids:
+            raise ConverterError(f"stub failure for {image_id}")
+        self.converted.append(image_id)
+        out = os.path.join(self.tmpdir,
+                           image_id.replace("/", "_") + ".jpx")
+        with open(out, "wb") as fh:
+            fh.write(b"JPX" + source_path.encode())
+        return out
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------- message bus ----------
+
+class TestMessageBus:
+    def test_request_reply(self):
+        async def go():
+            bus = MessageBus()
+
+            async def double(msg):
+                return Reply.success({"x": msg["x"] * 2})
+
+            bus.consumer("doubler", double)
+            reply = await bus.request("doubler", {"x": 21})
+            await bus.close()
+            return reply
+
+        reply = run(go())
+        assert reply.is_success and reply.body["x"] == 42
+
+    def test_retry_then_success(self):
+        async def go():
+            bus = MessageBus(retry_delay=0.01)
+            calls = []
+
+            async def flaky(msg):
+                calls.append(1)
+                return Reply.retry() if len(calls) < 3 else Reply.success()
+
+            bus.consumer("flaky", flaky)
+            reply = await bus.request_with_retry("flaky", {})
+            await bus.close()
+            return reply, len(calls)
+
+        reply, n = run(go())
+        assert reply.is_success and n == 3
+
+    def test_handler_exception_becomes_failure(self):
+        async def go():
+            bus = MessageBus()
+
+            async def boom(msg):
+                raise ValueError("kaput")
+
+            bus.consumer("boom", boom)
+            reply = await bus.request("boom", {})
+            await bus.close()
+            return reply
+
+        reply = run(go())
+        assert reply.op == "failure" and "kaput" in reply.message
+
+    def test_unknown_address(self):
+        async def go():
+            bus = MessageBus()
+            try:
+                await bus.request("nowhere", {})
+            finally:
+                await bus.close()
+
+        with pytest.raises(Exception):
+            run(go())
+
+
+# ---------- S3 uploader ----------
+
+def _uploader(tmp_path, **kw):
+    client = FakeS3Client(str(tmp_path / "s3"))
+    counters = Counters()
+    uploads = UploadsMap()
+    worker = S3UploadWorker(
+        client, S3UploaderConfig(bucket="main", **kw), counters, uploads)
+    return client, counters, uploads, worker
+
+
+class TestS3Uploader:
+    def test_upload_success_records_and_deletes_derivative(self, tmp_path):
+        # reference: S3BucketVerticle.java:168-175,286-303
+        client, counters, uploads, worker = _uploader(tmp_path)
+        src = tmp_path / "img.jpx"
+        src.write_bytes(b"data")
+
+        async def go():
+            bus = MessageBus()
+            worker.register(bus)
+            reply = await bus.request(S3_UPLOADER, {
+                c.IMAGE_ID: "ark.jpx", c.FILE_PATH: str(src),
+                c.JOB_NAME: "j", c.DERIVATIVE_IMAGE: True})
+            await bus.close()
+            return reply
+
+        reply = run(go())
+        assert reply.is_success
+        assert client.exists("main", "ark.jpx")
+        assert client.metadata["main/ark.jpx"][c.JOB_NAME] == "j"
+        assert uploads.get("ark.jpx") is not None
+        assert not src.exists()            # derivative deleted
+        assert counters.get(c.S3_REQUEST_COUNT) == 0   # slot released
+
+    def test_source_upload_not_deleted(self, tmp_path):
+        client, _, _, worker = _uploader(tmp_path)
+        src = tmp_path / "src.tif"
+        src.write_bytes(b"tiff")
+
+        async def go():
+            bus = MessageBus()
+            worker.register(bus)
+            reply = await bus.request(S3_UPLOADER, {
+                c.IMAGE_ID: "src.tif", c.FILE_PATH: str(src)})
+            await bus.close()
+            return reply
+
+        assert run(go()).is_success
+        assert src.exists()                # sources are kept
+
+    def test_backpressure_over_cap_replies_retry(self, tmp_path):
+        # reference: S3BucketVerticle.java:88-108
+        client, counters, _, worker = _uploader(tmp_path, max_requests=2)
+        counters.increment(c.S3_REQUEST_COUNT)
+        counters.increment(c.S3_REQUEST_COUNT)   # cap reached
+        src = tmp_path / "x.jpx"
+        src.write_bytes(b"d")
+
+        async def go():
+            bus = MessageBus()
+            worker.register(bus)
+            reply = await bus.request(S3_UPLOADER, {
+                c.IMAGE_ID: "x.jpx", c.FILE_PATH: str(src)})
+            await bus.close()
+            return reply
+
+        reply = run(go())
+        assert reply.is_retry
+        assert counters.get(c.S3_REQUEST_COUNT) == 2   # no slot leak
+
+    def test_500_replies_retry_forever(self, tmp_path):
+        # reference: S3BucketVerticle.java:185-194 — 5xx is infinite retry
+        client, _, _, worker = _uploader(tmp_path)
+        client.fail_next = [500, 503]
+        src = tmp_path / "y.jpx"
+        src.write_bytes(b"d")
+
+        async def go():
+            bus = MessageBus(retry_delay=0.01)
+            worker.register(bus)
+            reply = await bus.request_with_retry(S3_UPLOADER, {
+                c.IMAGE_ID: "y.jpx", c.FILE_PATH: str(src)})
+            await bus.close()
+            return reply
+
+        assert run(go()).is_success
+
+    def test_bounded_retries_then_failure(self, tmp_path):
+        # reference: S3BucketVerticle.java:219-277 — counter capped at
+        # s3.max.retries, then a failure reply and counter reset
+        client, counters, _, worker = _uploader(tmp_path, max_retries=3)
+        client.fail_next = [403] * 10
+        src = tmp_path / "z.jpx"
+        src.write_bytes(b"d")
+
+        async def go():
+            bus = MessageBus(retry_delay=0.001)
+            worker.register(bus)
+            reply = await bus.request_with_retry(S3_UPLOADER, {
+                c.IMAGE_ID: "z.jpx", c.FILE_PATH: str(src)})
+            await bus.close()
+            return reply
+
+        reply = run(go())
+        assert reply.op == "failure"
+        assert counters.get("retries-z.jpx") == 0      # reset after giving up
+
+
+# ---------- status-update seam + finalize ----------
+
+def _batch_fixture(tmp_path, n_items=2):
+    files = []
+    for i in range(n_items):
+        f = tmp_path / f"img{i}.tif"
+        f.write_bytes(b"II*\x00")
+        files.append(f.name)
+    csv_text = "Item ARK,File Name\n" + "\n".join(
+        f"ark:/1/{i},{name}" for i, name in enumerate(files)) + "\n"
+    job = job_factory.create_job(
+        "test-job", csv_text, prefix=pp.GenericFilePathPrefix(str(tmp_path)))
+    return job
+
+
+class TestStatusAndFinalize:
+    def test_patch_seam_completes_job(self, tmp_path):
+        # The fake-lambda e2e (reference: utils/FilesystemWriteCsvFfOnT
+        # .java:96-200, src/test/scripts/fake-lambda.sh): PATCH every
+        # EMPTY item true, assert the finalize wrote the CSV mount file.
+        job = _batch_fixture(tmp_path)
+        store = JobStore()
+        store.put(job)
+        csv_mount = tmp_path / "csv-out"
+        config = cfg.Config.load(overrides={
+            cfg.FILESYSTEM_CSV_MOUNT: str(csv_mount),
+            cfg.IIIF_URL: "http://iiif.test/iiif",
+            cfg.SLACK_CHANNEL_ID: "chan",
+        })
+        flags = features.FeatureFlagChecker(
+            static={features.FS_WRITE_CSV: True})
+        slack_client = RecordingSlackClient()
+
+        async def go():
+            bus = MessageBus()
+            FinalizeJobWorker(store, bus, config, flags).register(bus)
+            SlackWorker(slack_client).register(bus)
+            done0 = await update_item_status(
+                store, bus, "test-job", "ark:/1/0", True,
+                "http://iiif.test/iiif")
+            done1 = await update_item_status(
+                store, bus, "test-job", "ark:/1/1", False, None)
+            await asyncio.sleep(0.05)      # let finalize drain
+            await bus.close()
+            return done0, done1
+
+        done0, done1 = run(go())
+        assert (done0, done1) == (False, True)
+        assert "test-job" not in store
+        out = (csv_mount / "test-job.csv").read_text()
+        assert "Bucketeer State" in out and "IIIF Access URL" in out
+        assert "succeeded" in out and "failed" in out
+        assert "http://iiif.test/iiif/ark%3A%2F1%2F0" in out
+        # Slack got the summary + CSV
+        assert any("csv" in msg.get("filename", "")
+                   for msg in slack_client.messages)
+        assert any("1 failed" in msg["text"]
+                   for msg in slack_client.messages)
+
+    def test_item_failure_worker(self, tmp_path):
+        job = _batch_fixture(tmp_path, n_items=1)
+        store = JobStore()
+        store.put(job)
+        config = cfg.Config.load(overrides={cfg.SLACK_CHANNEL_ID: "chan"})
+        flags = features.FeatureFlagChecker(static={})
+        slack_client = RecordingSlackClient()
+
+        async def go():
+            bus = MessageBus()
+            ItemFailureWorker(store, bus).register(bus)
+            FinalizeJobWorker(store, bus, config, flags).register(bus)
+            SlackWorker(slack_client).register(bus)
+            reply = await bus.request(ITEM_FAILURE, {
+                c.JOB_NAME: "test-job", c.IMAGE_ID: "ark:/1/0"})
+            await asyncio.sleep(0.05)
+            await bus.close()
+            return reply
+
+        assert run(go()).is_success
+        assert "test-job" not in store     # finalized after last item
+
+    def test_unknown_job_404(self):
+        store = JobStore()
+
+        async def go():
+            bus = MessageBus()
+            ItemFailureWorker(store, bus).register(bus)
+            reply = await bus.request(ITEM_FAILURE, {
+                c.JOB_NAME: "ghost", c.IMAGE_ID: "x"})
+            await bus.close()
+            return reply
+
+        reply = run(go())
+        assert reply.op == "failure" and reply.code in (404, 500)
+
+
+# ---------- batch dispatch + in-process converter ----------
+
+class TestBatchPath:
+    def test_full_batch_lifecycle(self, tmp_path):
+        """CSV -> dispatch -> TPU(stub) convert -> S3 -> status -> finalize."""
+        job = _batch_fixture(tmp_path, n_items=3)
+        store = JobStore()
+        store.put(job)
+        s3 = FakeS3Client(str(tmp_path / "s3"))
+        counters, uploads = Counters(), UploadsMap()
+        config = cfg.Config.load(overrides={
+            cfg.IIIF_URL: "http://iiif.test/iiif",
+            cfg.SLACK_CHANNEL_ID: "chan",
+        })
+        flags = features.FeatureFlagChecker(static={})
+        conv = StubConverter(tmp_path, fail_ids={"ark:/1/2"})
+        slack_client = RecordingSlackClient()
+
+        async def go():
+            bus = MessageBus(retry_delay=0.01)
+            S3UploadWorker(s3, S3UploaderConfig(bucket="main"),
+                           counters, uploads).register(bus)
+            BatchConverterWorker(conv, store, bus, config).register(bus)
+            ItemFailureWorker(store, bus).register(bus)
+            FinalizeJobWorker(store, bus, config, flags).register(bus)
+            SlackWorker(slack_client).register(bus)
+            await start_job(job, bus, config, flags)
+            for _ in range(200):           # wait for the job to finalize
+                if "test-job" not in store:
+                    break
+                await asyncio.sleep(0.02)
+            await bus.close()
+
+        run(go())
+        assert "test-job" not in store
+        assert sorted(conv.converted) == ["ark:/1/0", "ark:/1/1"]
+        # Derivatives of the two successes landed in the main bucket
+        assert len(s3.metadata) == 2
+        summary = [msg for msg in slack_client.messages
+                   if "done" in msg.get("text", "")]
+        assert summary and "1 failed" in summary[0]["text"]
+
+    def test_oversized_without_flag_fails_item(self, tmp_path):
+        job = _batch_fixture(tmp_path, n_items=1)
+        big = tmp_path / "img0.tif"
+        big.write_bytes(b"x" * 2048)
+        store = JobStore()
+        store.put(job)
+        config = cfg.Config.load(overrides={
+            cfg.MAX_SOURCE_SIZE: 1024,
+            cfg.SLACK_CHANNEL_ID: "chan"})
+        flags = features.FeatureFlagChecker(
+            static={features.LARGE_IMAGES: False})
+        slack_client = RecordingSlackClient()
+
+        async def go():
+            bus = MessageBus()
+            ItemFailureWorker(store, bus).register(bus)
+            FinalizeJobWorker(store, bus, config,
+                              features.FeatureFlagChecker(static={})
+                              ).register(bus)
+            SlackWorker(slack_client).register(bus)
+            await start_job(job, bus, config, flags)
+            for _ in range(100):
+                if "test-job" not in store:
+                    break
+                await asyncio.sleep(0.02)
+            await bus.close()
+
+        run(go())
+        assert job.items[0].workflow_state is m.WorkflowState.FAILED
+
+    def test_nothing_processed_finalizes_immediately(self, tmp_path):
+        # reference: LoadCsvHandler.java:309-313
+        csv_text = ("Item ARK,File Name,Object Type,viewingHint\n"
+                    "ark:/1/c,,Collection,\n")
+        job = job_factory.create_job(
+            "empty-job", csv_text,
+            prefix=pp.GenericFilePathPrefix(str(tmp_path)))
+        store = JobStore()
+        store.put(job)
+        config = cfg.Config.load(overrides={cfg.SLACK_CHANNEL_ID: "chan"})
+        flags = features.FeatureFlagChecker(static={})
+        slack_client = RecordingSlackClient()
+
+        async def go():
+            bus = MessageBus()
+            FinalizeJobWorker(store, bus, config, flags).register(bus)
+            SlackWorker(slack_client).register(bus)
+            await start_job(job, bus, config, flags)
+            await asyncio.sleep(0.05)
+            await bus.close()
+
+        run(go())
+        assert "empty-job" not in store
+        assert any("nothing to process" in msg["text"]
+                   for msg in slack_client.messages)
+
+
+# ---------- single-image worker ----------
+
+class TestImageWorker:
+    def test_convert_upload_and_callback(self, tmp_path):
+        # reference: ImageWorkerVerticle.java:58-105 — success reply
+        # before upload; callback PATCHed true after
+        src = tmp_path / "in.tif"
+        src.write_bytes(b"II*\x00")
+        s3 = FakeS3Client(str(tmp_path / "s3"))
+        conv = StubConverter(tmp_path)
+        patches = []
+
+        async def fake_http(method, url):
+            patches.append((method, url))
+            return 200
+
+        async def go():
+            bus = MessageBus(retry_delay=0.01)
+            S3UploadWorker(s3, S3UploaderConfig(bucket="main"),
+                           Counters(), UploadsMap()).register(bus)
+            worker = ImageWorker(conv, bus, http_client=fake_http)
+            worker.register(bus)
+            reply = await bus.request("image-worker", {
+                c.IMAGE_ID: "ark:/9/img", c.FILE_PATH: str(src),
+                c.CALLBACK_URL: "http://caller/batch/jobs/j/ark:/9/img"})
+            for _ in range(100):
+                if patches:
+                    break
+                await asyncio.sleep(0.02)
+            await bus.close()
+            return reply
+
+        reply = run(go())
+        assert reply.is_success
+        assert reply.body[c.IMAGE_ID] == "ark:/9/img"
+        assert len(s3.metadata) == 1
+        assert patches and patches[0][1].endswith("/true")
+
+    def test_convert_failure_patches_false(self, tmp_path):
+        src = tmp_path / "in.tif"
+        src.write_bytes(b"II*\x00")
+        conv = StubConverter(tmp_path, fail_ids={"bad"})
+        patches = []
+
+        async def fake_http(method, url):
+            patches.append((method, url))
+            return 200
+
+        async def go():
+            bus = MessageBus()
+            worker = ImageWorker(conv, bus, http_client=fake_http)
+            worker.register(bus)
+            reply = await bus.request("image-worker", {
+                c.IMAGE_ID: "bad", c.FILE_PATH: str(src),
+                c.CALLBACK_URL: "http://caller/cb"})
+            await bus.close()
+            return reply
+
+        reply = run(go())
+        assert reply.op == "failure"
+        assert patches and patches[0][1].endswith("/false")
