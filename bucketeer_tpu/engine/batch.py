@@ -25,7 +25,7 @@ from ..converters import Conversion, ConverterError
 from ..models import Job, WorkflowState
 from .bus import MessageBus, Reply
 from .s3 import S3_UPLOADER
-from .store import JobStore
+from .store import JobStore, LockTimeout
 from .workers import (FINALIZE_JOB, ITEM_FAILURE, LARGE_IMAGE,
                       update_item_status)
 
@@ -69,13 +69,26 @@ class BatchConverterWorker:
             LOG.error("batch convert failed for %s: %s", image_id, exc)
         except Exception as exc:
             LOG.exception("batch item %s errored: %s", image_id, exc)
-        try:
-            await update_item_status(
-                self.store, self.bus, job_name, image_id, ok,
-                self.config.get_str(cfg.IIIF_URL))
-        except KeyError:
-            LOG.warning("job %s vanished before item %s resolved",
-                        job_name, image_id)
+        for attempt in range(3):
+            try:
+                await update_item_status(
+                    self.store, self.bus, job_name, image_id, ok,
+                    self.config.get_str(cfg.IIIF_URL))
+                break
+            except KeyError:
+                LOG.warning("job %s vanished before item %s resolved",
+                            job_name, image_id)
+                break
+            except LockTimeout:
+                # A transient lock timeout must not strand the item as
+                # EMPTY forever (the job would never finalize); retry.
+                LOG.warning("job lock timeout updating %s/%s (attempt %d)",
+                            job_name, image_id, attempt + 1)
+                await asyncio.sleep(0.1 * (attempt + 1))
+        else:
+            # Status never written: requeue the whole message rather than
+            # ack it, or the item stays EMPTY and the job never finalizes.
+            return Reply.retry()
         return Reply.success() if ok else Reply.failure(
             500, f"conversion failed for {image_id}")
 
@@ -132,9 +145,12 @@ async def start_job(job: Job, bus: MessageBus, config,
             dispatched += 1
         elif large_ok:
             # reference: LoadCsvHandler.java:270-281
+            # Send the absolute prefixed path — the same one the size check
+            # used — matching the reference's source.getAbsolutePath()
+            # (reference: LoadCsvHandler.java:256).
             reply = await bus.request_with_retry(LARGE_IMAGE, {
                 c.JOB_NAME: job.name, c.IMAGE_ID: item.id,
-                c.FILE_PATH: item.file_path,
+                c.FILE_PATH: path,
             })
             if not reply.is_success:
                 await bus.send(ITEM_FAILURE, {c.JOB_NAME: job.name,

@@ -113,8 +113,12 @@ class HttpS3Client:
             f"{h}:{str(headers[next(k for k in headers if k.lower() == h)]).strip()}\n"
             for h in signed)
         signed_list = ";".join(signed)
+        # parts.path is already single-percent-encoded by _url (quote with
+        # safe="/"), which is exactly the canonical-URI form SigV4 wants;
+        # re-quoting would double-encode ('%3A' -> '%253A') and break the
+        # signature for every ARK-derived key.
         canonical = "\n".join([
-            method, urllib.parse.quote(parts.path, safe="/"),
+            method, parts.path,
             parts.query, canonical_headers, signed_list, payload_hash])
         scope = f"{datestamp}/{self.region}/s3/aws4_request"
         to_sign = "\n".join([
@@ -135,24 +139,50 @@ class HttpS3Client:
         del headers["host"]   # aiohttp sets it
         return headers
 
+    CHUNK = 1 << 20
+
     async def put(self, bucket: str, key: str, file_path: str,
                   metadata: dict | None = None) -> None:
         import aiohttp
 
         if self._session is None:
             self._session = aiohttp.ClientSession()
-        with open(file_path, "rb") as fh:
-            body = fh.read()
-        payload_hash = hashlib.sha256(body).hexdigest()
+        # Stream the object: one chunked pass to hash, one to send, so a
+        # 300 MB source never lives in RAM (reference streams too,
+        # S3BucketVerticle.java:141-155).
+        size, payload_hash = await asyncio.to_thread(
+            self._hash_file, file_path)
         url = self._url(bucket, key)
         headers = {f"x-amz-meta-{k}": str(v)
                    for k, v in (metadata or {}).items()}
-        headers["content-length"] = str(len(body))
+        headers["content-length"] = str(size)
         headers = self._sign("PUT", url, headers, payload_hash)
-        async with self._session.put(url, data=body,
-                                     headers=headers) as resp:
+
+        async def body():
+            with open(file_path, "rb") as fh:
+                # Reads go through a thread so a slow disk/NFS never
+                # stalls the event loop mid-upload.
+                while chunk := await asyncio.to_thread(fh.read, self.CHUNK):
+                    yield chunk
+
+        # encoded=True keeps yarl from re-quoting the path (it would turn
+        # %3A back into ':'), so the wire path is byte-identical to the
+        # canonical URI we signed.
+        import yarl
+        async with self._session.put(yarl.URL(url, encoded=True),
+                                     data=body(), headers=headers) as resp:
             if resp.status != 200:
                 raise S3Error(resp.status, (await resp.text())[:500])
+
+    @classmethod
+    def _hash_file(cls, path: str) -> tuple[int, str]:
+        digest = hashlib.sha256()
+        size = 0
+        with open(path, "rb") as fh:
+            while chunk := fh.read(cls.CHUNK):
+                digest.update(chunk)
+                size += len(chunk)
+        return size, digest.hexdigest()
 
     async def close(self) -> None:
         if self._session is not None:

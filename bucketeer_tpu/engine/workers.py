@@ -83,10 +83,13 @@ class ImageWorker:
 
     async def _upload(self, image_id: str, derivative: str,
                       callback_url: str | None) -> None:
+        # Upload under the URL-encoded derivative filename, matching the
+        # reference's jpx.getName() key (ImageWorkerVerticle.java:68) and
+        # this service's own batch path, so the same image always lands
+        # under one S3 key format.
         jpx_name = os.path.basename(derivative)
         reply = await self.bus.request_with_retry(S3_UPLOADER, {
-            c.IMAGE_ID: urllib.parse.unquote(os.path.splitext(jpx_name)[0])
-            + os.path.splitext(jpx_name)[1],
+            c.IMAGE_ID: jpx_name,
             c.FILE_PATH: derivative,
             c.DERIVATIVE_IMAGE: True,
         })

@@ -241,8 +241,15 @@ class Api:
         if job.remaining() != before:
             return _error_page(
                 400, f"job '{job_name}' is still processing")
-        async with self.engine.store.locked():
-            self.engine.store.remove(job_name)
+        try:
+            async with self.engine.store.locked():
+                self.engine.store.remove(job_name)
+        except KeyError:
+            # Finalized (or deleted) between the probe and the remove.
+            return _error_page(404, f"job not found: {job_name}")
+        except LockTimeout:
+            # Match updateBatchJob's contention behavior: 503, not 500.
+            return _error_page(503, "job lock timed out; try again")
         return web.Response(status=204)
 
     # --- metrics (new: SURVEY.md §5 says the reference has none) ---
