@@ -96,14 +96,27 @@ def plt(packet_lengths: list, zplt: int = 0) -> bytes:
 
 
 def assemble(main_segments: list, tiles: list) -> bytes:
-    """tiles: list of (tile_idx, [aux_segments], packet_bytes)."""
+    """tiles: list of (tile_idx, [aux_segments], packet_bytes) — one
+    tile-part per tile."""
+    return assemble_parts(main_segments, [
+        (tile_idx, 0, 1, aux, packets)
+        for tile_idx, aux, packets in tiles])
+
+
+def assemble_parts(main_segments: list, tileparts: list) -> bytes:
+    """Multi-tile-part assembly (reference recipe ``ORGtparts=R`` splits
+    each tile at resolution boundaries, KakaduConverter.java:40).
+
+    tileparts: list of (tile_idx, tpsot, tnsot, [aux_segments],
+    packet_bytes) in codestream order.
+    """
     out = bytearray(struct.pack(">H", SOC))
     for seg in main_segments:
         out += seg
-    for tile_idx, aux, packets in tiles:
+    for tile_idx, tpsot, tnsot, aux, packets in tileparts:
         aux_len = sum(len(a) for a in aux)
         psot = 12 + aux_len + 2 + len(packets)
-        out += sot(tile_idx, psot)
+        out += sot(tile_idx, psot, tpsot, tnsot)
         for a in aux:
             out += a
         out += struct.pack(">H", SOD)

@@ -7,8 +7,17 @@ Pipeline (SURVEY.md §7 minimum slice):
   host image array -> [device] level shift + RCT/ICT + tiled multi-level
   DWT + quantization (one jitted XLA program per tile shape,
   bucketeer_tpu.codec.pipeline; tiles batched per shape group so an
-  image is at most four device calls) -> [host] EBCOT Tier-1 per
-  code-block -> Tier-2 packets -> codestream -> JP2/JPX boxes.
+  image is at most four device calls) -> EBCOT Tier-1 per code-block ->
+  PCRD-opt layer allocation (codec/rate.py) -> Tier-2 packets with real
+  precincts, any of the five progressions, SOP/EPH/PLT markers and
+  per-resolution tile-parts -> codestream -> JP2/JPX boxes.
+
+The full structural recipe of the reference's Kakadu invocation
+(``Clevels=6 Clayers=6 Cprecincts={256,256},{256,256},{128,128}
+Stiles={512,512} Corder=RPCL ORGgen_plt=yes ORGtparts=R Cblk={64,64}
+Cuse_sop=yes Cuse_eph=yes``, lossy ``-rate 3``; reference:
+converters/KakaduConverter.java:38-44) is available via
+:meth:`EncodeParams.kakadu_recipe`.
 
 This module is the orchestration; it works standalone on CPU (the same
 jitted program runs on the host backend) so the service runs in a no-TPU
@@ -17,13 +26,16 @@ absent (reference: converters/ConverterFactory.java:37-47).
 """
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from . import codestream as cs
 from . import jp2 as jp2box
+from . import rate as rate_mod
 from . import t1, t1_batch, t2
+from .dwt import synthesis_gains
 from .pipeline import TilePlan, extract_bands, make_plan, run_tiles
 from .quant import GUARD_BITS, SubbandQuant
 
@@ -38,47 +50,146 @@ class EncodeParams:
     base_delta: float = 0.5            # irreversible base step (image domain)
     n_layers: int = 1
     progression: int = cs.PROG_LRCP
+    rate: float | None = None          # target bpp for the whole file (lossy)
+    precincts: tuple | None = None     # ((w,h),...) highest-resolution first
+    use_sop: bool = False
+    use_eph: bool = False
+    gen_plt: bool = False
+    tparts_r: bool = False             # tile-part per resolution (ORGtparts=R)
     comment: str = "bucketeer-tpu jp2 encoder"
+
+    @classmethod
+    def kakadu_recipe(cls, lossless: bool,
+                      rate: float | None = 3.0) -> "EncodeParams":
+        """The reference's exact Kakadu option set
+        (converters/KakaduConverter.java:38-44): 6 levels, 6 layers,
+        512x512 tiles, RPCL, precincts 256/256/128, SOP+EPH, PLT,
+        R tile-parts; lossless = reversible unbounded rate, lossy 3 bpp.
+        """
+        return cls(lossless=lossless, levels=6, tile_size=512,
+                   base_delta=1.0 if lossless else 2.0,
+                   n_layers=6, progression=cs.PROG_RPCL,
+                   rate=None if lossless else rate,
+                   precincts=((256, 256), (256, 256), (128, 128)),
+                   use_sop=True, use_eph=True, gen_plt=True, tparts_r=True)
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -((-a) // b)
+
+
+def _band_rect(tcx0: int, tcx1: int, tcy0: int, tcy1: int,
+               res: int, name: str, levels: int) -> tuple:
+    """Global band-coordinate rectangle of a tile-component's subband
+    (T.800 eq. B-15, image/tile offsets 0)."""
+    if name == "LL":
+        k, xob, yob = levels, 0, 0
+    else:
+        k = levels - res + 1
+        xob = 1 if name in ("HL", "HH") else 0
+        yob = 1 if name in ("LH", "HH") else 0
+    step = 1 << k
+    half = 1 << (k - 1)
+    bx0 = _ceil_div(tcx0 - half * xob, step)
+    bx1 = _ceil_div(tcx1 - half * xob, step)
+    by0 = _ceil_div(tcy0 - half * yob, step)
+    by1 = _ceil_div(tcy1 - half * yob, step)
+    return bx0, bx1, by0, by1
+
+
+def _precinct_exps(params: EncodeParams, levels: int) -> list:
+    """Per-resolution (PPx, PPy) exponents on the resolution grid,
+    r=0 (coarsest) first. Kakadu's Cprecincts lists highest resolution
+    first with the last entry repeating downward
+    (KakaduConverter.java:39)."""
+    if not params.precincts:
+        return [(15, 15)] * (levels + 1)
+    spec = [(int(math.log2(w)), int(math.log2(h)))
+            for w, h in params.precincts]
+    out = []
+    for r in range(levels + 1):
+        i = levels - r
+        ppx, ppy = spec[i] if i < len(spec) else spec[-1]
+        eff = ppx - (1 if r > 0 else 0)
+        assert eff >= CBLK_EXP, (
+            f"precinct 2^{ppx} at res {r} smaller than the 64x64 "
+            "code-block; shrink Cblk or grow the precinct")
+        out.append((ppx, ppy))
+    return out
+
+
+# L2 norms of the inverse multi-component transform's columns: a unit
+# error in Y/Cb/Cr maps to this much RGB error, so PCRD must scale
+# component distortions by norm² or chroma is starved (the classic
+# "grayscale matches, RGB lags" failure).
+_ICT_NORMS = (1.7321, 1.8051, 1.5734)
+_RCT_NORMS = (1.7321, 0.8292, 0.8292)
 
 
 @dataclass
 class _Band:
-    name: str           # LL / HL / LH / HH
-    mags: np.ndarray    # uint magnitudes (quantizer indices)
-    signs: np.ndarray
+    name: str
+    res: int
+    comp: int
     q: SubbandQuant
-    blocks: list = field(default_factory=list)        # t1.CodedBlock, raster
-    grid: tuple = (0, 0)                              # (nblocks_h, nblocks_w)
+    bx0: int
+    bx1: int
+    by0: int
+    by1: int
+    mags: np.ndarray | None
+    signs: np.ndarray | None
+    blocks: dict = field(default_factory=dict)  # (cy, cx) -> t1.CodedBlock
+
+    @property
+    def cell_range(self):
+        """Global 64-grid cell index ranges [cx0, cx1) x [cy0, cy1)."""
+        if self.bx1 <= self.bx0 or self.by1 <= self.by0:
+            return 0, 0, 0, 0
+        return (self.bx0 >> CBLK_EXP, ((self.bx1 - 1) >> CBLK_EXP) + 1,
+                self.by0 >> CBLK_EXP, ((self.by1 - 1) >> CBLK_EXP) + 1)
 
 
 def _collect_blocks(band: _Band, specs: list, dests: list) -> None:
-    """Append this band's code-block inputs to the global batch."""
-    h, w = band.mags.shape
-    if h == 0 or w == 0:
-        band.grid = (0, 0)
-        return
-    nbh = (h + (1 << CBLK_EXP) - 1) >> CBLK_EXP
-    nbw = (w + (1 << CBLK_EXP) - 1) >> CBLK_EXP
-    band.grid = (nbh, nbw)
-    for by in range(nbh):
-        for bx in range(nbw):
-            y0, x0 = by << CBLK_EXP, bx << CBLK_EXP
-            specs.append((band.mags[y0:y0 + 64, x0:x0 + 64],
-                          band.signs[y0:y0 + 64, x0:x0 + 64], band.name))
-            dests.append(band)
+    """Queue this band's code-blocks (global 64-grid cells intersecting
+    the tile-band rect — anchored at 0 in *global* band coordinates, per
+    T.800 B.7) into the image-wide Tier-1 batch."""
+    cx0, cx1, cy0, cy1 = band.cell_range
+    for cy in range(cy0, cy1):
+        for cx in range(cx0, cx1):
+            gy0 = max(cy << CBLK_EXP, band.by0)
+            gy1 = min((cy + 1) << CBLK_EXP, band.by1)
+            gx0 = max(cx << CBLK_EXP, band.bx0)
+            gx1 = min((cx + 1) << CBLK_EXP, band.bx1)
+            ly0, lx0 = gy0 - band.by0, gx0 - band.bx0
+            specs.append((band.mags[ly0:ly0 + gy1 - gy0,
+                                    lx0:lx0 + gx1 - gx0],
+                          band.signs[ly0:ly0 + gy1 - gy0,
+                                     lx0:lx0 + gx1 - gx0],
+                          band.name))
+            dests.append((band, cy, cx))
 
 
-def _tile_bands(planes: np.ndarray, plan: TilePlan, specs: list,
-                dests: list):
-    """(C, h, w) coefficient planes -> [component][resolution] band lists,
-    queueing code-block inputs into the global Tier-1 batch."""
+def _tile_bands(planes: np.ndarray, plan: TilePlan, origin: tuple,
+                specs: list, dests: list):
+    """(C, h, w) coefficient planes -> [component][resolution] band lists
+    in global coordinates, queueing code-block inputs."""
+    y0, x0 = origin
+    tcx1, tcy1 = x0 + plan.tile_w, y0 + plan.tile_h
     comp_res = []
     for c in range(planes.shape[0]):
         resolutions = []
-        for res in extract_bands(planes[c], plan):
+        for res_bands in extract_bands(planes[c], plan):
             bands = []
-            for slot, mags, signs in res:
-                band = _Band(slot.name, mags, signs, slot.quant)
+            for slot, mags, signs in res_bands:
+                bx0, bx1, by0, by1 = _band_rect(
+                    x0, tcx1, y0, tcy1, slot.resolution, slot.name,
+                    plan.levels)
+                assert (by1 - by0, bx1 - bx0) == (slot.h, slot.w), (
+                    f"band {slot.name}@r{slot.resolution}: global rect "
+                    f"{(by1 - by0, bx1 - bx0)} != local {(slot.h, slot.w)}"
+                    " — tile origin not aligned for this level count")
+                band = _Band(slot.name, slot.resolution, c, slot.quant,
+                             bx0, bx1, by0, by1, mags, signs)
                 _collect_blocks(band, specs, dests)
                 bands.append(band)
             resolutions.append(bands)
@@ -86,54 +197,170 @@ def _tile_bands(planes: np.ndarray, plan: TilePlan, specs: list,
     return comp_res
 
 
-def _tile_packets(comp_resolutions: list, n_layers: int,
-                  progression: int) -> bytes:
-    """Build the packet stream for one tile. comp_resolutions:
-    [component][resolution] -> list[_Band]."""
-    n_comps = len(comp_resolutions)
-    n_res = len(comp_resolutions[0])
-
-    # Build Tier-2 precinct state (default precincts: one per band).
-    precincts = {}  # (comp, res) -> list[t2.Precinct]
-    for c in range(n_comps):
-        for r in range(n_res):
-            plist = []
-            for band in comp_resolutions[c][r]:
-                nbh, nbw = band.grid
-                prec = t2.Precinct(nbw, nbh)
-                for i, blk in enumerate(band.blocks):
-                    pb = t2.PrecinctBlock(
-                        missing_bitplanes=band.q.n_bitplanes - blk.n_bitplanes)
-                    if blk.n_bitplanes > 0:
-                        pb.layers = _layer_split(blk, n_layers)
-                    prec.blocks[i] = pb
-                plist.append(prec)
-            precincts[(c, r)] = plist
-
-    out = bytearray()
-    if progression == cs.PROG_LRCP:
-        order = ((l, r, c) for l in range(n_layers)
-                 for r in range(n_res) for c in range(n_comps))
-    elif progression == cs.PROG_RLCP:
-        order = ((l, r, c) for r in range(n_res)
-                 for l in range(n_layers) for c in range(n_comps))
-    else:
-        # RPCL/PCRL/CPRL need per-precinct position iteration; until the
-        # precinct machinery lands, refuse rather than emit a codestream
-        # whose packet order contradicts its COD marker.
-        raise NotImplementedError(
-            f"progression {progression} not yet supported (LRCP/RLCP only)")
-    for l, r, c in order:
-        out += t2.encode_packet(precincts[(c, r)], l, n_layers)
-    return bytes(out)
-
-
-def _layer_split(blk: t1.CodedBlock, n_layers: int) -> dict:
-    """Assign coding passes to quality layers. Single-layer: everything in
-    layer 0. (PCRD-opt multi-layer allocation plugs in here.)"""
+def _block_layers(blk: t1.CodedBlock,
+                  assign: rate_mod.LayerAssignment | None) -> dict:
+    """LayerAssignment boundaries -> per-layer BlockLayer slices."""
     if not blk.passes:
         return {}
-    return {0: t2.BlockLayer(len(blk.passes), blk.data)}
+    layers = {}
+    prev_p, prev_b = 0, 0
+    for layer, (cp, cb) in enumerate(assign.boundaries):
+        if cp > prev_p:
+            layers[layer] = t2.BlockLayer(cp - prev_p, blk.data[prev_b:cb])
+            prev_p, prev_b = cp, cb
+    return layers
+
+
+@dataclass
+class _PrecinctRec:
+    comp: int
+    res: int
+    p_idx: int          # raster index within (comp, res)
+    ref_y: int          # reference-grid position (progression ordering)
+    ref_x: int
+    band_precincts: list
+
+
+def _build_precincts(comp_res: list, origin: tuple, plan: TilePlan,
+                     exps: list, assigns_of) -> list:
+    """Partition a tile's bands into precincts (anchored at 0 on each
+    *global* resolution grid, T.800 B.6) and fill Tier-2 block state."""
+    y0, x0 = origin
+    tcx1, tcy1 = x0 + plan.tile_w, y0 + plan.tile_h
+    levels = plan.levels
+    records = []
+    for c, resolutions in enumerate(comp_res):
+        for r, bands in enumerate(resolutions):
+            e = levels - r
+            trx0, trx1 = _ceil_div(x0, 1 << e), _ceil_div(tcx1, 1 << e)
+            try0, try1 = _ceil_div(y0, 1 << e), _ceil_div(tcy1, 1 << e)
+            if trx1 <= trx0 or try1 <= try0:
+                continue
+            ppx, ppy = exps[r]
+            px_lo, px_hi = trx0 >> ppx, ((trx1 - 1) >> ppx) + 1
+            py_lo, py_hi = try0 >> ppy, ((try1 - 1) >> ppy) + 1
+            shift = 0 if r == 0 else 1
+            p_idx = 0
+            for py in range(py_lo, py_hi):
+                for px in range(px_lo, px_hi):
+                    bps = []
+                    for band in bands:
+                        pbx0 = (px << ppx) >> shift
+                        pbx1 = ((px + 1) << ppx) >> shift
+                        pby0 = (py << ppy) >> shift
+                        pby1 = ((py + 1) << ppy) >> shift
+                        cx0, cx1, cy0, cy1 = band.cell_range
+                        kx0 = max(cx0, pbx0 >> CBLK_EXP)
+                        kx1 = min(cx1, _ceil_div(pbx1, 1 << CBLK_EXP))
+                        ky0 = max(cy0, pby0 >> CBLK_EXP)
+                        ky1 = min(cy1, _ceil_div(pby1, 1 << CBLK_EXP))
+                        nbw, nbh = max(0, kx1 - kx0), max(0, ky1 - ky0)
+                        prec = t2.Precinct(nbw, nbh)
+                        for i, (cy, cx) in enumerate(
+                                (cy, cx) for cy in range(ky0, ky1)
+                                for cx in range(kx0, kx1)):
+                            blk = band.blocks[(cy, cx)]
+                            pb = t2.PrecinctBlock(
+                                missing_bitplanes=band.q.n_bitplanes
+                                - blk.n_bitplanes)
+                            pb.layers = _block_layers(blk, assigns_of(blk))
+                            prec.blocks[i] = pb
+                        bps.append(prec)
+                    ref_y = max(try0, py << ppy) << e
+                    ref_x = max(trx0, px << ppx) << e
+                    records.append(_PrecinctRec(c, r, p_idx, ref_y, ref_x,
+                                                bps))
+                    p_idx += 1
+    return records
+
+
+def _packet_sequence(progression: int, records: list, n_res: int,
+                     n_comps: int, n_layers: int):
+    """Yield (record, layer) in codestream packet order (T.800 B.12).
+
+    Position-based progressions order precincts by their reference-grid
+    position; components here always have unit subsampling, so sorting
+    by the precinct's (y, x) anchor is exactly the standard's positional
+    scan."""
+    if progression == cs.PROG_LRCP:
+        recs = sorted(records, key=lambda p: (p.res, p.comp, p.p_idx))
+        for l in range(n_layers):
+            for rec in recs:
+                yield rec, l
+    elif progression == cs.PROG_RLCP:
+        recs = sorted(records, key=lambda p: (p.res, p.comp, p.p_idx))
+        for r in range(n_res):
+            for l in range(n_layers):
+                for rec in recs:
+                    if rec.res == r:
+                        yield rec, l
+    elif progression == cs.PROG_RPCL:
+        recs = sorted(records,
+                      key=lambda p: (p.res, p.ref_y, p.ref_x, p.comp))
+        for rec in recs:
+            for l in range(n_layers):
+                yield rec, l
+    elif progression == cs.PROG_PCRL:
+        recs = sorted(records,
+                      key=lambda p: (p.ref_y, p.ref_x, p.comp, p.res))
+        for rec in recs:
+            for l in range(n_layers):
+                yield rec, l
+    elif progression == cs.PROG_CPRL:
+        recs = sorted(records,
+                      key=lambda p: (p.comp, p.ref_y, p.ref_x, p.res))
+        for rec in recs:
+            for l in range(n_layers):
+                yield rec, l
+    else:
+        raise ValueError(f"unknown progression {progression}")
+
+
+def _tile_parts(params: EncodeParams, tidx: int, records: list,
+                n_res: int, n_comps: int) -> list:
+    """Encode a tile's packets and split them into tile-parts.
+
+    Returns [(tile_idx, tpsot, tnsot, aux_segments, body)]. With
+    ``tparts_r`` and a resolution-major progression this is one
+    tile-part per resolution (``ORGtparts=R``), each carrying its own
+    PLT when ``gen_plt`` (KakaduConverter.java:40)."""
+    split_r = params.tparts_r and params.progression in (cs.PROG_RPCL,
+                                                         cs.PROG_RLCP)
+    groups: list = []        # [(packets bytes list, lengths list)]
+    group_of_res: dict = {}
+    sop_counter = 0
+    for rec, layer in _packet_sequence(params.progression, records, n_res,
+                                       n_comps, params.n_layers):
+        pkt = t2.encode_packet(
+            rec.band_precincts, layer, params.n_layers,
+            sop_index=sop_counter if params.use_sop else None,
+            use_eph=params.use_eph)
+        sop_counter += 1
+        key = rec.res if split_r else 0
+        if key not in group_of_res:
+            group_of_res[key] = len(groups)
+            groups.append(([], []))
+        pkts, lens = groups[group_of_res[key]]
+        pkts.append(pkt)
+        lens.append(len(pkt))
+
+    parts = []
+    tnsot = len(groups)
+    for tpsot, (pkts, lens) in enumerate(groups):
+        aux = [cs.plt(lens, zplt=tpsot)] if params.gen_plt else []
+        parts.append((tidx, tpsot, tnsot, aux, b"".join(pkts)))
+    return parts
+
+
+def _band_weight(slot, gains) -> float:
+    """PCRD distortion weight: (step x 2-D synthesis L2 norm)²."""
+    ll_gain, band_gains = gains
+    if slot.name == "LL":
+        g = ll_gain
+    else:
+        lvl = len(band_gains) - slot.resolution + 1
+        g = band_gains[lvl - 1][slot.name]
+    return (slot.quant.delta * g) ** 2
 
 
 def encode_array(img: np.ndarray, bitdepth: int = 8,
@@ -148,11 +375,12 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
 
     if img.ndim == 2:
         img = img[..., None]
+    used_mct = n_comps == 3
 
     # Group tiles by shape: interior tiles batch into one device call;
     # ragged right/bottom tiles form up to three more groups.
-    n_tiles_x = (w + tile - 1) // tile
-    n_tiles_y = (h + tile - 1) // tile
+    n_tiles_x = _ceil_div(w, tile)
+    n_tiles_y = _ceil_div(h, tile)
     groups: dict = {}
     for ty in range(n_tiles_y):
         for tx in range(n_tiles_x):
@@ -167,6 +395,8 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     dests: list = []
     tile_records = []
     qcd_values = None
+    gains = synthesis_gains(levels, params.lossless)
+    weight_of_slot: dict = {}
     for (th, tw), members in groups.items():
         plan = make_plan(th, tw, n_comps, levels, params.lossless, bitdepth,
                          params.base_delta)
@@ -175,47 +405,89 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         planes = run_tiles(plan, batch)              # (B, C, th, tw)
         if qcd_values is None:
             qcd_values = _qcd_values(plan)
-        for (tidx, _, _), tile_planes in zip(members, planes):
-            comp_res = _tile_bands(tile_planes, plan, specs, dests)
-            tile_records.append((tidx, comp_res))
+        for s in plan.slots:
+            weight_of_slot.setdefault((s.resolution, s.name),
+                                      _band_weight(s, gains))
+        for (tidx, y0, x0), tile_planes in zip(members, planes):
+            comp_res = _tile_bands(tile_planes, plan, (y0, x0), specs,
+                                   dests)
+            tile_records.append((tidx, (y0, x0), plan, comp_res))
 
     # Phase 2: one Tier-1 batch over every code-block in the image (native
     # thread pool when available).
-    for band, blk in zip(dests, t1_batch.encode_blocks(specs)):
+    all_blocks: list = []
+    block_weights: list = []
+    assign_index: dict = {}     # id(CodedBlock) -> index
+    for (band, cy, cx), blk in zip(dests, t1_batch.encode_blocks(specs)):
         assert blk.n_bitplanes <= band.q.n_bitplanes, (
             f"block bitplanes {blk.n_bitplanes} exceed Mb "
             f"{band.q.n_bitplanes} in {band.name}")
-        band.blocks.append(blk)
+        band.blocks[(cy, cx)] = blk
+        assign_index[id(blk)] = len(all_blocks)
+        all_blocks.append(blk)
+        if used_mct:
+            norms = _RCT_NORMS if params.lossless else _ICT_NORMS
+            cw = norms[band.comp] ** 2
+        else:
+            cw = 1.0
+        block_weights.append(weight_of_slot[(band.res, band.name)] * cw)
     # Coefficients are fully entropy-coded now; drop them so a huge image
     # doesn't hold every tile's magnitude/sign planes through Tier-2.
     specs.clear()
-    dests.clear()
-    for _, comp_res in tile_records:
+    for _, _, _, comp_res in tile_records:
         for resolutions in comp_res:
             for bands in resolutions:
                 for band in bands:
                     band.mags = band.signs = None
 
-    # Phase 3: Tier-2 packets per tile.
-    tiles = []
-    for tidx, comp_res in tile_records:
-        packets = _tile_packets(comp_res, params.n_layers,
-                                params.progression)
-        tiles.append((tidx, [], packets))
-    tiles.sort(key=lambda item: item[0])
-
-    used_mct = n_comps == 3
+    # Phase 3: PCRD layer allocation + Tier-2, iterated once or twice so
+    # the assembled file size (headers included) lands on the target.
+    exps = _precinct_exps(params, levels)
     segs = [
         cs.siz(w, h, n_comps, bitdepth, tile, tile),
         cs.cod(params.progression, params.n_layers,
                use_mct=used_mct, levels=levels,
                cblk_w_exp=CBLK_EXP, cblk_h_exp=CBLK_EXP,
-               reversible=params.lossless),
+               reversible=params.lossless,
+               precinct_exps=exps if params.precincts else None,
+               use_sop=params.use_sop, use_eph=params.use_eph),
         cs.qcd(0 if params.lossless else 2, GUARD_BITS, qcd_values),
     ]
     if params.comment:
         segs.append(cs.com(params.comment))
-    return cs.assemble(segs, tiles)
+
+    def build(budget: float | None) -> bytes:
+        assigns = rate_mod.allocate(all_blocks, block_weights,
+                                    params.n_layers, budget)
+
+        def assigns_of(blk):
+            return assigns[assign_index[id(blk)]]
+
+        parts = []
+        for tidx, origin, plan, comp_res in sorted(tile_records,
+                                                   key=lambda t: t[0]):
+            records = _build_precincts(comp_res, origin, plan, exps,
+                                       assigns_of)
+            parts.extend(_tile_parts(params, tidx, records, levels + 1,
+                                     n_comps))
+        return cs.assemble_parts(segs, parts)
+
+    target = None
+    if params.rate is not None and not params.lossless:
+        target = params.rate * w * h / 8.0
+    if target is None:
+        return build(None)
+
+    # Budget the block bytes, then correct for actual header overhead.
+    budget = max(1024.0, target * 0.96)
+    out = build(budget)
+    for _ in range(3):
+        err = len(out) - target
+        if abs(err) <= 0.02 * target:
+            break
+        budget = max(1024.0, budget - err)
+        out = build(budget)
+    return out
 
 
 def _qcd_values(plan: TilePlan) -> list:

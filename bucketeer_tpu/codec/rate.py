@@ -1,0 +1,129 @@
+"""PCRD-opt rate control: rate-distortion-optimal truncation of Tier-1
+pass streams into quality layers (T.800 Annex J.10 / EBCOT's
+post-compression rate-distortion optimization).
+
+The reference delegates this to Kakadu's ``-rate 3`` / ``Clayers=6``
+options (reference: converters/KakaduConverter.java:38-43); here it is
+explicit: every code-block's feasible truncation points (pass ends) are
+reduced to their convex hull in (bytes, weighted-distortion) space, hull
+segments are merged globally by R-D slope, and layer boundaries are byte
+budgets on that global slope-ordered walk — so layer L is exactly "the
+best bytes to spend first", which is what makes the 6-layer progressive
+stream meaningful.
+
+Distortion weighting: Tier-1 reports per-pass distortion reduction in
+quantizer-index units²; multiplying by (delta_b * g_b)² — quantizer step
+times the 2-D L2 synthesis norm of the subband — converts to image-domain
+MSE so slopes are comparable across subbands and resolutions.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class LayerAssignment:
+    """Per-block result: for each layer, the cumulative (n_passes, bytes)
+    boundary after that layer's contribution. Layers with no new passes
+    for this block simply repeat the previous boundary."""
+    boundaries: list        # [(cum_passes, cum_bytes)] per layer
+
+
+def _hull(block, weight: float):
+    """Lower-rate/upper-distortion convex hull of a block's truncation
+    points. Returns [(pass_idx, cum_len, cum_dist)] with strictly
+    decreasing slopes between consecutive points (origin excluded)."""
+    pts = [(-1, 0, 0.0)]
+    cum = 0.0
+    for i, p in enumerate(block.passes):
+        cum += p.dist_reduction * weight
+        pts.append((i, p.cum_length, cum))
+
+    hull = [pts[0]]
+    for pt in pts[1:]:
+        if pt[1] <= hull[-1][1]:
+            # No extra bytes: keep whichever has more distortion benefit
+            # (later pass index wins ties so npasses stays consistent).
+            if pt[2] >= hull[-1][2] and len(hull) > 1:
+                hull[-1] = pt
+            continue
+        while len(hull) >= 2:
+            x0, y0 = hull[-2][1], hull[-2][2]
+            x1, y1 = hull[-1][1], hull[-1][2]
+            # Slope to candidate from hull[-2] >= slope of last segment
+            # means hull[-1] is not on the upper hull.
+            if (pt[2] - y0) * (x1 - x0) >= (y1 - y0) * (pt[1] - x0):
+                hull.pop()
+            else:
+                break
+        # Only keep points that improve distortion.
+        if pt[2] > hull[-1][2]:
+            hull.append(pt)
+    return hull
+
+
+def layer_budgets(target_bytes: float | None, total_bytes: int,
+                  n_layers: int) -> list:
+    """Cumulative byte budgets per layer: logarithmically spaced halvings
+    ending at the target (Kakadu's default layer spacing for
+    ``Clayers=N -rate R``). With no target (lossless ``-rate -``), the
+    spacing is applied to the actual coded size and the last layer is
+    unbounded so every pass ships."""
+    final = float(target_bytes) if target_bytes is not None else float(
+        total_bytes)
+    budgets = [final / (2 ** (n_layers - 1 - i)) for i in range(n_layers)]
+    if target_bytes is None:
+        budgets[-1] = float("inf")
+    return budgets
+
+
+def allocate(blocks: list, weights: list, n_layers: int,
+             target_bytes: float | None) -> list[LayerAssignment]:
+    """Assign coding passes to quality layers.
+
+    blocks: list of t1.CodedBlock; weights: per-block distortion weight
+    (delta_b * g_b)²; target_bytes: budget for the sum of block bytes
+    (codestream headers are the caller's problem), or None = include
+    everything (lossless).
+
+    Returns one LayerAssignment per block.
+    """
+    segments = []   # (slope, block_idx, seg_order, d_len, pass_idx, cum_len)
+    for bi, (blk, w) in enumerate(zip(blocks, weights)):
+        hull = _hull(blk, w)
+        for si in range(1, len(hull)):
+            p0, l0, d0 = hull[si - 1]
+            p1, l1, d1 = hull[si]
+            slope = (d1 - d0) / (l1 - l0)
+            segments.append((slope, bi, si, l1 - l0, p1, l1))
+    # Global R-D order: steepest slope first; per-block segment order is
+    # preserved because hull slopes strictly decrease within a block.
+    segments.sort(key=lambda s: (-s[0], s[1], s[2]))
+
+    total = sum(s[3] for s in segments)
+    budgets = layer_budgets(target_bytes, total, n_layers)
+
+    state = [(0, 0)] * len(blocks)     # running (cum_passes, cum_bytes)
+    assigns = [LayerAssignment([]) for _ in blocks]
+    cum = 0
+    seg_i = 0
+    for layer in range(n_layers):
+        budget = budgets[layer]
+        while seg_i < len(segments):
+            slope, bi, _, d_len, pass_idx, cum_len = segments[seg_i]
+            if cum + d_len > budget:
+                break
+            cum += d_len
+            state[bi] = (pass_idx + 1, cum_len)
+            seg_i += 1
+        for bi in range(len(blocks)):
+            assigns[bi].boundaries.append(state[bi])
+    if target_bytes is None:
+        # No byte budget (lossless `-rate -`): the hull only ordered the
+        # *early* layers; the final layer must carry every coding pass,
+        # hull point or not, or reconstruction is no longer exact.
+        for bi, (blk, _) in enumerate(zip(blocks, weights)):
+            if blk.passes:
+                assigns[bi].boundaries[-1] = (len(blk.passes),
+                                              len(blk.data))
+    return assigns

@@ -156,11 +156,15 @@ def encode_block(mags: np.ndarray, signs: np.ndarray, band: str) -> CodedBlock:
         ctx, xor = _SC[(hc, vc)]
         mq.encode(int(neg[y, x]) ^ xor, ctx)
 
+    # True magnitude is ~(index + 0.5) steps — the index floors |c|/delta
+    # — so estimates use tv = v + 0.5, matching native/t1.cpp; without
+    # the offset PCRD mis-ranks small-index (noise) blocks.
     def sig_dist(y: int, x: int, p: int) -> float:
         v = m[y, x]
         vb = (v >> p) << p
+        tv = v + 0.5
         r = vb + (1 << p) * 0.5
-        return float(v * v - (v - r) * (v - r))
+        return float(tv * tv - (tv - r) * (tv - r))
 
     def ref_dist(y: int, x: int, p: int) -> float:
         v = m[y, x]
@@ -168,7 +172,8 @@ def encode_block(mags: np.ndarray, signs: np.ndarray, band: str) -> CodedBlock:
         r1 = v1 + (1 << (p + 1)) * 0.5
         v0 = (v >> p) << p
         r0 = v0 + (1 << p) * 0.5
-        return float((v - r1) * (v - r1) - (v - r0) * (v - r0))
+        tv = v + 0.5
+        return float((tv - r1) * (tv - r1) - (tv - r0) * (tv - r0))
 
     def stripes():
         for y0 in range(0, h, 4):

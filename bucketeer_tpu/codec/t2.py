@@ -173,9 +173,15 @@ class Precinct:
         self.zbp_tree.set_values(zbp_vals)
 
 
-def encode_packet(precincts, layer: int, n_layers: int) -> bytes:
+def encode_packet(precincts, layer: int, n_layers: int,
+                  sop_index: int | None = None,
+                  use_eph: bool = False) -> bytes:
     """Encode one packet: the given layer for a list of band-precincts
-    (the bands of one resolution), header + body. Without SOP/EPH."""
+    (the bands of one resolution at one precinct position), header +
+    body. ``sop_index`` non-None prepends an SOP marker segment with that
+    sequence number (reference recipe ``Cuse_sop=yes``); ``use_eph``
+    appends the EPH marker after the packet header (``Cuse_eph=yes``) —
+    KakaduConverter.java:40."""
     bw = BitWriter()
     body = bytearray()
     any_data = any(
@@ -217,7 +223,15 @@ def encode_packet(precincts, layer: int, n_layers: int) -> bytes:
                 bw.put_bits(length, nbits_len)
                 body += bl.data
     header = bw.flush()
-    return header + bytes(body)
+    out = bytearray()
+    if sop_index is not None:
+        out += SOP.to_bytes(2, "big") + (4).to_bytes(2, "big")
+        out += (sop_index & 0xFFFF).to_bytes(2, "big")
+    out += header
+    if use_eph:
+        out += EPH.to_bytes(2, "big")
+    out += body
+    return bytes(out)
 
 
 def _floor_log2(n: int) -> int:

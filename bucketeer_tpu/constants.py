@@ -16,6 +16,7 @@ FILE_PATH = "file-path"
 JOB_NAME = "job-name"
 CALLBACK_URL = "callback-url"
 DERIVATIVE_IMAGE = "derivative-image"
+CONVERSION_TYPE = "conversion-type"
 SLACK_HANDLE = "slack-handle"
 FAILURES = "failures"
 STATUS = "status"

@@ -1,10 +1,12 @@
 """The in-process TPU converter — the component the reference outsources
 to the Kakadu binary (reference: converters/KakaduConverter.java:55-77).
 
-Mirrors the Kakadu encode recipe structurally (reference:
-KakaduConverter.java:38-44): 6 decomposition levels, 64x64 code-blocks,
-1024-tiled large images; lossless = reversible 5/3 + RCT, lossy =
-irreversible 9/7 + ICT at the configured rate.
+Emits the reference's full Kakadu recipe (reference:
+KakaduConverter.java:38-44): ``Clevels=6 Clayers=6
+Cprecincts={256,256},{256,256},{128,128} Stiles={512,512} Corder=RPCL
+ORGgen_plt=yes ORGtparts=R Cblk={64,64} Cuse_sop=yes Cuse_eph=yes``;
+lossless = reversible 5/3 + RCT (``Creversible=yes -rate -``), lossy =
+irreversible 9/7 + ICT with PCRD-opt truncation to 3 bpp (``-rate 3``).
 """
 from __future__ import annotations
 
@@ -14,13 +16,7 @@ from ..codec import tiff
 from ..codec.encoder import EncodeParams, encode_jp2
 from .base import Conversion, ConverterError, output_path
 
-# Tile images larger than this many pixels (kdu runs untiled but the
-# reference recipe declares Stiles={512,512}; we tile big inputs so the
-# device program stays one of a few static shapes).
-TILE_THRESHOLD = 2048 * 2048
-TILE_SIZE = 1024
-LEVELS = 6          # reference: Clevels=6
-LOSSY_BASE_DELTA = 2.0
+LOSSY_RATE = 3.0    # reference: -rate 3 (KakaduConverter.java:43)
 
 
 class TpuConverter:
@@ -28,10 +24,9 @@ class TpuConverter:
 
     name = "TPU"
 
-    def __init__(self, levels: int = LEVELS, lossy_base_delta: float =
-                 LOSSY_BASE_DELTA, jpx: bool = True) -> None:
-        self.levels = levels
-        self.lossy_base_delta = lossy_base_delta
+    def __init__(self, lossy_rate: float = LOSSY_RATE,
+                 jpx: bool = True) -> None:
+        self.lossy_rate = lossy_rate
         self.jpx = jpx
 
     def convert(self, image_id: str, source_path: str,
@@ -45,18 +40,17 @@ class TpuConverter:
                 f"cannot read {source_path}: {exc}") from exc
 
         h, w = img.shape[:2]
-        levels = self.levels
-        # Tiny images can't sustain 6 levels; clamp like encoders do.
-        while levels > 1 and (min(h, w) >> levels) < 4:
-            levels -= 1
-        params = EncodeParams(
+        params = EncodeParams.kakadu_recipe(
             lossless=conversion == Conversion.LOSSLESS,
-            levels=levels,
-            tile_size=TILE_SIZE if h * w > TILE_THRESHOLD else None,
-            # The base step is calibrated for 8-bit signals; scale it with
-            # the signal range so 16-bit scans lose proportionally.
-            base_delta=self.lossy_base_delta * (1 << (bitdepth - 8)),
-        )
+            rate=self.lossy_rate)
+        # Tiny images can't sustain 6 levels; clamp like encoders do.
+        while params.levels > 1 and (min(h, w) >> params.levels) < 4:
+            params.levels -= 1
+        if max(h, w) <= params.tile_size:
+            params.tile_size = None         # single tile, like kdu untiled
+        # The base step is calibrated for 8-bit signals; scale it with
+        # the signal range so deeper scans quantize proportionally.
+        params.base_delta *= (1 << (bitdepth - 8))
         try:
             data = encode_jp2(img, bitdepth, params, jpx=self.jpx)
         except Exception as exc:

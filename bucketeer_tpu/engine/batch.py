@@ -54,10 +54,12 @@ class BatchConverterWorker:
         image_id = message[c.IMAGE_ID]
         file_path = message[c.FILE_PATH]
         ok = False
+        conversion = Conversion(
+            message.get(c.CONVERSION_TYPE)
+            or self.config.get_str(cfg.CONVERSION_TYPE) or "lossless")
         try:
             derivative = await asyncio.to_thread(
-                self.converter.convert, image_id, file_path,
-                Conversion.LOSSLESS)
+                self.converter.convert, image_id, file_path, conversion)
             reply = await self.bus.request_with_retry(S3_UPLOADER, {
                 c.IMAGE_ID: os.path.basename(derivative),
                 c.FILE_PATH: derivative,
@@ -94,7 +96,8 @@ class BatchConverterWorker:
 
 
 async def start_job(job: Job, bus: MessageBus, config,
-                    flags: features.FeatureFlagChecker) -> None:
+                    flags: features.FeatureFlagChecker,
+                    conversion: str | None = None) -> None:
     """Dispatch every pending item of a queued job (reference:
     LoadCsvHandler.java:237-314):
 
@@ -138,10 +141,11 @@ async def start_job(job: Job, bus: MessageBus, config,
                     await bus.send(ITEM_FAILURE, {c.JOB_NAME: job.name,
                                                   c.IMAGE_ID: item.id})
             else:
-                await bus.send(BATCH_CONVERTER, {
-                    c.JOB_NAME: job.name, c.IMAGE_ID: item.id,
-                    c.FILE_PATH: path,
-                })
+                msg = {c.JOB_NAME: job.name, c.IMAGE_ID: item.id,
+                       c.FILE_PATH: path}
+                if conversion:
+                    msg[c.CONVERSION_TYPE] = conversion
+                await bus.send(BATCH_CONVERTER, msg)
             dispatched += 1
         elif large_ok:
             # reference: LoadCsvHandler.java:270-281

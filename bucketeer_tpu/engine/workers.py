@@ -50,10 +50,12 @@ class ImageWorker:
     ImageWorkerVerticle.java:58-105)."""
 
     def __init__(self, converter, bus: MessageBus,
-                 http_client=None) -> None:
+                 http_client=None,
+                 default_conversion: str = "lossless") -> None:
         self.converter = converter
         self.bus = bus
         self.http_client = http_client     # async (method,url)->status
+        self.default_conversion = default_conversion
         self.background: set[asyncio.Task] = set()
 
     def register(self, bus: MessageBus, instances: int = 1) -> None:
@@ -65,10 +67,14 @@ class ImageWorker:
         image_id = message[c.IMAGE_ID]
         file_path = message[c.FILE_PATH]
         callback_url = message.get(c.CALLBACK_URL)
+        # Conversion type is a request parameter with a configured
+        # default (the reference hardwires LOSSLESS,
+        # ImageWorkerVerticle.java:58-64).
+        conversion = Conversion(
+            message.get(c.CONVERSION_TYPE) or self.default_conversion)
         try:
             derivative = await asyncio.to_thread(
-                self.converter.convert, image_id, file_path,
-                Conversion.LOSSLESS)
+                self.converter.convert, image_id, file_path, conversion)
         except ConverterError as exc:
             if callback_url:
                 await self._patch_callback(callback_url, False)

@@ -242,13 +242,17 @@ static void encode_block(const uint32_t* mags, const uint8_t* negs,
         chi[p] = negs[y * w + x] ? -1 : 1;
     };
 
+    // True coefficient magnitude is ~(index + 0.5) quantizer steps (the
+    // index floors |c|/delta), so distortion estimates use tv = v + 0.5;
+    // without the offset, small-index (noise-dominated) blocks get
+    // mis-ranked slopes and PCRD splits rate badly across components.
     auto sig_dist = [&](int y, int x, int p) -> double {
         int64_t v = mags[y * w + x];
         int64_t vb = (v >> p) << p;
+        double tv = (double)v + 0.5;
         double r = (double)vb + (double)(1ll << p) * 0.5;
-        double vv = (double)(v * v);
-        double d = (double)v - r;
-        return vv - d * d;
+        double d = tv - r;
+        return tv * tv - d * d;
     };
 
     auto ref_dist = [&](int y, int x, int p) -> double {
@@ -257,7 +261,8 @@ static void encode_block(const uint32_t* mags, const uint8_t* negs,
         double r1 = (double)v1 + (double)(1ll << (p + 1)) * 0.5;
         int64_t v0 = (v >> p) << p;
         double r0 = (double)v0 + (double)(1ll << p) * 0.5;
-        double d1 = (double)v - r1, d0 = (double)v - r0;
+        double tv = (double)v + 0.5;
+        double d1 = tv - r1, d0 = tv - r0;
         return d1 * d1 - d0 * d0;
     };
 
