@@ -37,8 +37,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .dwt import dwt2d_forward, synthesis_gains
-from .quant import (SubbandQuant, quantize, signal_irreversible,
-                    signal_reversible, step_for_subband)
+from .quant import (FRAC_BITS, SubbandQuant, quantize_fp,
+                    signal_irreversible, signal_reversible,
+                    step_for_subband)
 from .transforms import ict_forward, level_shift_forward, rct_forward
 
 
@@ -95,9 +96,16 @@ def _band_geometry(h: int, w: int, levels: int):
 @lru_cache(maxsize=256)
 def make_plan(tile_h: int, tile_w: int, n_comps: int, levels: int,
               lossless: bool, bitdepth: int,
-              base_delta: float = 0.5) -> TilePlan:
-    """Build the static plan: geometry + signaled quantizer per subband."""
-    used_mct = n_comps == 3
+              base_delta: float = 0.5,
+              use_mct: bool | None = None) -> TilePlan:
+    """Build the static plan: geometry + signaled quantizer per subband.
+
+    ``use_mct`` — apply the multi-component transform (RCT/ICT) to a
+    3-component tile; None = yes whenever there are 3 components. The
+    encoder passes an explicit value from its per-image adaptive choice
+    (encoder._mct_helps)."""
+    used_mct = n_comps == 3 if use_mct is None else (use_mct
+                                                    and n_comps == 3)
     rct_extra = 1 if (used_mct and lossless) else 0
     ll_gain, gains = synthesis_gains(levels, lossless)
 
@@ -156,7 +164,7 @@ def _transform_batch(plan: TilePlan, step_map: jnp.ndarray,
     coeffs = _mallat(ll, bands)
     if plan.lossless:
         return coeffs.astype(jnp.int32)
-    return quantize(coeffs, step_map)
+    return quantize_fp(coeffs, step_map)
 
 
 @lru_cache(maxsize=256)
@@ -197,12 +205,21 @@ def extract_bands(plane: np.ndarray, plan: TilePlan):
     """Slice one component's (h, w) int32 Mallat plane into
     resolution-major band arrays.
 
-    Returns [resolution][band] of (slot, mags uint32, signs bool).
+    Returns [resolution][band] of (slot, mags uint32, signs bool,
+    fracs uint8|None). Lossy planes are fixed point with FRAC_BITS
+    fractional magnitude bits (quantize_fp): the coded index is
+    ``fp >> FRAC_BITS`` and the low bits drive Tier-1's distortion
+    estimates. Lossless coefficients are exact integers (fracs=None).
     """
     n_res = plan.levels + 1
     resolutions = [[] for _ in range(n_res)]
     for s in plan.slots:
         idx = plane[s.y0:s.y0 + s.h, s.x0:s.x0 + s.w].astype(np.int64)
-        resolutions[s.resolution].append(
-            (s, np.abs(idx).astype(np.uint32), idx < 0))
+        mag = np.abs(idx)
+        if plan.lossless:
+            mags, fracs = mag.astype(np.uint32), None
+        else:
+            mags = (mag >> FRAC_BITS).astype(np.uint32)
+            fracs = (mag & ((1 << FRAC_BITS) - 1)).astype(np.uint8)
+        resolutions[s.resolution].append((s, mags, idx < 0, fracs))
     return resolutions

@@ -24,6 +24,12 @@ import jax.numpy as jnp
 
 GUARD_BITS = 2
 
+# Fractional magnitude bits kept alongside the quantizer index for PCRD
+# distortion estimation (the index alone only locates a coefficient to
+# within one step; the fraction pins the true |c|/delta so R-D slopes
+# rank correctly when many blocks have near-identical statistics).
+FRAC_BITS = 7
+
 # log2 of the nominal dynamic-range gain per subband type (T.800 E.1.1).
 _LOG2_GAIN = {"LL": 0, "HL": 1, "LH": 1, "HH": 2}
 
@@ -37,15 +43,19 @@ class SubbandQuant:
     n_bitplanes: int  # M_b
 
 
-def quantize(coeffs: jnp.ndarray, delta: float) -> jnp.ndarray:
-    """Deadzone scalar quantizer -> signed int32 indices."""
-    q = jnp.floor(jnp.abs(coeffs) / delta).astype(jnp.int32)
+def quantize_fp(coeffs: jnp.ndarray, delta: float) -> jnp.ndarray:
+    """Deadzone scalar quantizer keeping FRAC_BITS fractional magnitude
+    bits: signed fixed-point ``sign * floor(|c|/delta * 2^FRAC_BITS)``.
+    The coded index is the fixed-point value >> FRAC_BITS (identical to
+    a plain ``floor(|c|/delta)``); the low bits feed Tier-1's distortion
+    estimates. Magnitudes are clamped below 2^31 so int32 never wraps —
+    an index that large (> 2^24) trips the encoder's ``Mb`` assertion
+    loudly instead of corrupting the codestream silently."""
+    scale = float(1 << FRAC_BITS)
+    lim = float(2 ** 31 - (1 << FRAC_BITS) - 1)
+    q = jnp.floor(jnp.minimum(jnp.abs(coeffs) / delta * scale,
+                              lim)).astype(jnp.int32)
     return jnp.where(coeffs < 0, -q, q)
-
-
-def dequantize(idx: jnp.ndarray, delta: float, reconstruction_bias: float = 0.5):
-    mag = (jnp.abs(idx).astype(jnp.float32) + reconstruction_bias) * delta
-    return jnp.where(idx == 0, 0.0, jnp.where(idx < 0, -mag, mag))
 
 
 def step_for_subband(base_delta: float, gain: float) -> float:

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .mq import MQEncoder, CTX_RL, CTX_UNIFORM
+from .quant import FRAC_BITS
 
 # --- Context tables (T.800 Tables D.1-D.4) ---
 
@@ -93,11 +94,15 @@ class CodedBlock:
     passes: list = field(default_factory=list)  # list[PassInfo]
 
 
-def encode_block(mags: np.ndarray, signs: np.ndarray, band: str) -> CodedBlock:
+def encode_block(mags: np.ndarray, signs: np.ndarray, band: str,
+                 fracs: np.ndarray | None = None) -> CodedBlock:
     """Encode one code-block.
 
     mags: (h, w) uint32 magnitudes (quantizer indices); signs: (h, w)
-    bool/int, nonzero = negative; band: LL/HL/LH/HH (context-table class).
+    bool/int, nonzero = negative; band: LL/HL/LH/HH (context-table class);
+    fracs: optional (h, w) uint8 fractional magnitude bits (FRAC_BITS of
+    |c|/delta below the index) for exact distortion estimation — None
+    means the indices are exact (reversible path).
     """
     h, w = mags.shape
     maxv = int(mags.max()) if mags.size else 0
@@ -156,13 +161,18 @@ def encode_block(mags: np.ndarray, signs: np.ndarray, band: str) -> CodedBlock:
         ctx, xor = _SC[(hc, vc)]
         mq.encode(int(neg[y, x]) ^ xor, ctx)
 
-    # True magnitude is ~(index + 0.5) steps — the index floors |c|/delta
-    # — so estimates use tv = v + 0.5, matching native/t1.cpp; without
-    # the offset PCRD mis-ranks small-index (noise) blocks.
+    # True magnitude in index units: the coded index plus the retained
+    # fractional bits (quantize_fp). With no fracs the indices are exact
+    # (reversible path). Accurate tv matters because PCRD ranks passes by
+    # slope; a fixed +0.5 midpoint mis-ranks blocks whose slopes cluster
+    # (e.g. chroma noise), splitting rate badly across components.
+    fr = (fracs.astype(np.float64) / float(1 << FRAC_BITS)
+          if fracs is not None else np.zeros((h, w)))
+
     def sig_dist(y: int, x: int, p: int) -> float:
         v = m[y, x]
         vb = (v >> p) << p
-        tv = v + 0.5
+        tv = v + fr[y, x]
         r = vb + (1 << p) * 0.5
         return float(tv * tv - (tv - r) * (tv - r))
 
@@ -172,7 +182,7 @@ def encode_block(mags: np.ndarray, signs: np.ndarray, band: str) -> CodedBlock:
         r1 = v1 + (1 << (p + 1)) * 0.5
         v0 = (v >> p) << p
         r0 = v0 + (1 << p) * 0.5
-        tv = v + 0.5
+        tv = v + fr[y, x]
         return float((tv - r1) * (tv - r1) - (tv - r0) * (tv - r0))
 
     def stripes():

@@ -25,32 +25,39 @@ def default_threads() -> int:
 
 
 def encode_blocks(specs: list) -> list:
-    """specs: [(mags uint32 (h,w), signs bool (h,w), band_name)] ->
-    [t1.CodedBlock] in order."""
+    """specs: [(mags uint32 (h,w), signs bool (h,w), band_name,
+    fracs uint8 (h,w) | None)] -> [t1.CodedBlock] in order."""
     lib = native.load()
     if lib is None or not specs:
-        return [t1.encode_block(m, s, b) for m, s, b in specs]
+        return [t1.encode_block(m, s, b, f) for m, s, b, f in specs]
 
     n = len(specs)
     offsets = np.zeros(n + 1, dtype=np.int64)
     hs = np.zeros(n, dtype=np.int32)
     ws = np.zeros(n, dtype=np.int32)
     cls = np.zeros(n, dtype=np.int32)
-    for i, (m, _, band) in enumerate(specs):
+    any_fracs = any(f is not None for _, _, _, f in specs)
+    for i, (m, _, band, _) in enumerate(specs):
         hs[i], ws[i] = m.shape
         cls[i] = _BAND_CLS[band]
         offsets[i + 1] = offsets[i] + m.size
     total = int(offsets[-1])
     mags = np.empty(total, dtype=np.uint32)
     negs = np.empty(total, dtype=np.uint8)
-    for i, (m, s, _) in enumerate(specs):
+    fracs = np.zeros(total, dtype=np.uint8) if any_fracs else None
+    for i, (m, s, _, f) in enumerate(specs):
         mags[offsets[i]:offsets[i + 1]] = np.ascontiguousarray(
             m, dtype=np.uint32).ravel()
         negs[offsets[i]:offsets[i + 1]] = np.ascontiguousarray(
             s, dtype=np.uint8).ravel()
+        if f is not None:
+            fracs[offsets[i]:offsets[i + 1]] = np.ascontiguousarray(
+                f, dtype=np.uint8).ravel()
 
     handle = lib.t1_encode_blocks(
-        n, mags.ctypes.data, negs.ctypes.data, offsets.ctypes.data,
+        n, mags.ctypes.data, negs.ctypes.data,
+        fracs.ctypes.data if fracs is not None else None,
+        offsets.ctypes.data,
         hs.ctypes.data, ws.ctypes.data, cls.ctypes.data, default_threads())
     try:
         nbps = np.zeros(n, dtype=np.int32)

@@ -20,6 +20,7 @@ from pathlib import Path
 
 _DIR = Path(__file__).resolve().parent
 _LIB_PATH = _DIR / "libbucketeer_t1.so"
+_ABI_VERSION = 2     # must match t1_abi_version() in t1.cpp
 _lib = None
 _tried = False
 
@@ -56,10 +57,30 @@ def load():
         lib = ctypes.CDLL(str(_LIB_PATH))
     except OSError:
         return None
+    # ABI guard: a prebuilt .so from an older tree (deployment images
+    # prune t1.cpp, defeating the mtime staleness check) must not be
+    # called with a newer argument layout. Rebuild if possible, else
+    # fall back to the pure-Python coder.
+    try:
+        lib.t1_abi_version.restype = ctypes.c_int32
+        abi = int(lib.t1_abi_version())
+    except AttributeError:
+        abi = -1
+    if abi != _ABI_VERSION:
+        if not (src.exists() and _build()):
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            lib.t1_abi_version.restype = ctypes.c_int32
+            if int(lib.t1_abi_version()) != _ABI_VERSION:
+                return None
+        except (OSError, AttributeError):
+            return None
     lib.t1_encode_blocks.restype = ctypes.c_void_p
     lib.t1_encode_blocks.argtypes = [
         ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int]
     lib.t1_block_sizes.restype = None
     lib.t1_block_sizes.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 3
     lib.t1_block_get.restype = None

@@ -195,7 +195,10 @@ struct BlockOut {
 };
 
 // Band class: 0 = LL/LH table, 1 = HH table, 2 = HL (LL/LH with H/V swap).
+// fracs: optional FRAC_BITS(=7) fractional magnitude bits below the index
+// (quantize_fp), null when indices are exact (reversible path).
 static void encode_block(const uint32_t* mags, const uint8_t* negs,
+                         const uint8_t* fracs,
                          int h, int w, int bandcls, BlockOut& out) {
     uint32_t maxv = 0;
     const int n = h * w;
@@ -242,14 +245,24 @@ static void encode_block(const uint32_t* mags, const uint8_t* negs,
         chi[p] = negs[y * w + x] ? -1 : 1;
     };
 
-    // True coefficient magnitude is ~(index + 0.5) quantizer steps (the
-    // index floors |c|/delta), so distortion estimates use tv = v + 0.5;
-    // without the offset, small-index (noise-dominated) blocks get
-    // mis-ranked slopes and PCRD splits rate badly across components.
+    // True magnitude in index units: coded index + retained fractional
+    // bits (quantize_fp; exact when fracs is null — reversible path).
+    // Accurate tv matters: PCRD ranks passes by slope, and a fixed +0.5
+    // midpoint mis-ranks blocks whose slopes cluster (chroma noise),
+    // splitting rate badly across components. Mirrors codec/t1.py.
+    // Must match bucketeer_tpu.codec.quant.FRAC_BITS (= 7): fracs carry
+    // 2^FRAC_BITS sub-index steps. Checked against the Python coder by
+    // tests/test_native_t1.py.
+    constexpr double FRAC_SCALE = 128.0;
+    auto true_val = [&](int y, int x) -> double {
+        int64_t v = mags[y * w + x];
+        return (double)v + (fracs ? fracs[y * w + x] / FRAC_SCALE : 0.0);
+    };
+
     auto sig_dist = [&](int y, int x, int p) -> double {
         int64_t v = mags[y * w + x];
         int64_t vb = (v >> p) << p;
-        double tv = (double)v + 0.5;
+        double tv = true_val(y, x);
         double r = (double)vb + (double)(1ll << p) * 0.5;
         double d = tv - r;
         return tv * tv - d * d;
@@ -261,7 +274,7 @@ static void encode_block(const uint32_t* mags, const uint8_t* negs,
         double r1 = (double)v1 + (double)(1ll << (p + 1)) * 0.5;
         int64_t v0 = (v >> p) << p;
         double r0 = (double)v0 + (double)(1ll << p) * 0.5;
-        double tv = (double)v + 0.5;
+        double tv = true_val(y, x);
         double d1 = tv - r1, d0 = tv - r0;
         return d1 * d1 - d0 * d0;
     };
@@ -390,8 +403,15 @@ struct T1Result {
 
 extern "C" {
 
+// Bumped whenever any exported signature changes; the Python loader
+// refuses a library whose version doesn't match, so a stale prebuilt
+// .so (deployment images may prune t1.cpp) fails loudly instead of
+// misreading the new argument layout.
+int32_t t1_abi_version() { return 2; }
+
 T1Result* t1_encode_blocks(int n_blocks,
                            const uint32_t* mags, const uint8_t* negs,
+                           const uint8_t* fracs,
                            const int64_t* offsets,
                            const int32_t* hs, const int32_t* ws,
                            const int32_t* bandcls, int n_threads) {
@@ -403,6 +423,7 @@ T1Result* t1_encode_blocks(int n_blocks,
             int i = next.fetch_add(1);
             if (i >= n_blocks) break;
             encode_block(mags + offsets[i], negs + offsets[i],
+                         fracs ? fracs + offsets[i] : nullptr,
                          hs[i], ws[i], bandcls[i], res->blocks[i]);
         }
     };

@@ -109,9 +109,13 @@ def test_kakadu_recipe_lossless_roundtrip(rng):
 
 
 def test_kakadu_recipe_lossy_rate_control(rng):
-    """Lossy `-rate 3` analog: the PCRD-truncated file lands within 5%
-    of 3.0 bpp and still decodes at reasonable quality
-    (KakaduConverter.java:43)."""
+    """Lossy `-rate 3` analog (KakaduConverter.java:43): the
+    PCRD-truncated file lands within 5% of 3.0 bpp and matches what
+    OpenJPEG (via Pillow) achieves on the same image at the same rate —
+    a matched-rate independent-encoder oracle rather than an absolute
+    threshold (this noisy image caps *any* encoder near 28.5 dB at
+    3 bpp). Adaptive MCT picks per-channel coding here, where the
+    channel noise is independent."""
     y, x = np.mgrid[0:512, 0:512]
     base = 128 + 80 * np.sin(x / 21.0) * np.cos(y / 17.0)
     img = np.clip(base[..., None] + rng.normal(0, 14, (512, 512, 3)),
@@ -120,8 +124,21 @@ def test_kakadu_recipe_lossy_rate_control(rng):
     data = encoder.encode_jp2(img, 8, params)
     bpp = 8.0 * len(data) / (512 * 512)
     assert abs(bpp - 3.0) <= 0.15, f"rate control missed: {bpp:.3f} bpp"
-    dec = _decode(data)
-    assert _psnr(dec, img) > 30.0
+    psnr = _psnr(_decode(data), img)
+
+    import io
+
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG2000", irreversible=True,
+                              quality_mode="rates",
+                              quality_layers=[24.0 / bpp])
+    ref = np.asarray(Image.open(io.BytesIO(buf.getvalue())))
+    ref_psnr = _psnr(ref, img)
+    # 0.25 dB headroom: the 512-tile recipe pays tile-boundary and
+    # marker overhead the single-tile OpenJPEG file does not.
+    assert psnr >= ref_psnr - 0.25, (
+        f"behind OpenJPEG at matched rate: {psnr:.2f} vs {ref_psnr:.2f}")
 
 
 def test_multilayer_truncation_prefix_decodes(rng):

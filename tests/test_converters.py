@@ -51,15 +51,49 @@ def test_tpu_converter_lossless(monkeypatch, tmp_path, tiff_file):
     np.testing.assert_array_equal(dec, img)
 
 
-def test_tpu_converter_lossy(monkeypatch, tmp_path, tiff_file):
+@pytest.fixture
+def photo_tiff(tmp_path, rng):
+    """Compressible photographic content (smooth shading + edges +
+    correlated channels + light sensor noise) — the content class the
+    lossy `-rate 3` recipe is for. An iid-noise image would need ~24 bpp
+    for 30 dB, so no encoder can look good on one at 3 bpp."""
+    y, x = np.mgrid[0:256, 0:384]
+    lum = (110 + 70 * np.sin(x / 19.0) * np.cos(y / 13.0)
+           + 25 * ((x // 32 + y // 32) % 2))
+    img = np.clip(np.stack([lum + 10, lum * 0.92, lum * 0.85], -1)
+                  + rng.normal(0, 3, (256, 384, 3)), 0, 255).astype(np.uint8)
+    path = tmp_path / "photo.tif"
+    Image.fromarray(img).save(path)
+    return str(path), img
+
+
+def test_tpu_converter_lossy(monkeypatch, tmp_path, photo_tiff):
+    """The production lossy path (kakadu recipe, -rate 3) on
+    photographic content: on-rate and high quality — and at least as
+    good as OpenJPEG (via Pillow) gets at the same byte budget
+    (matched-rate independent-encoder oracle, BASELINE.md)."""
     monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
-    src, img = tiff_file
+    src, img = photo_tiff
     out = TpuConverter().convert("ark:/1/xyz", src, Conversion.LOSSY)
     dec = np.asarray(Image.open(out))
     assert dec.shape == img.shape
     mse = np.mean((dec.astype(float) - img.astype(float)) ** 2)
     psnr = 10 * np.log10(255 ** 2 / max(mse, 1e-9))
-    assert psnr > 30.0
+    assert psnr > 34.0, f"lossy quality collapsed: {psnr:.2f} dB"
+
+    bpp = 8.0 * os.path.getsize(out) / (img.shape[0] * img.shape[1])
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG2000", irreversible=True,
+                              quality_mode="rates",
+                              quality_layers=[24.0 / bpp])
+    ref = np.asarray(Image.open(io.BytesIO(buf.getvalue())))
+    ref_psnr = 10 * np.log10(
+        255 ** 2 / max(np.mean((ref.astype(float) - img) ** 2), 1e-9))
+    # 0.3 dB allowance: the production recipe carries 6 quality layers
+    # plus SOP/EPH/PLT markers (progressive streaming the flat 1-layer
+    # OpenJPEG file doesn't offer) inside the same byte budget.
+    assert psnr >= ref_psnr - 0.3, (
+        f"behind OpenJPEG at matched rate: {psnr:.2f} vs {ref_psnr:.2f} dB")
 
 
 def test_tpu_converter_16bit_gray(monkeypatch, tmp_path, gray16_tiff):

@@ -24,17 +24,20 @@ def _random_blocks(rng, n=12):
             0, 1 << int(rng.integers(1, 14)), size=(h, w))
         signs = rng.random((h, w)) < 0.5
         band = ["LL", "HL", "LH", "HH"][i % 4]
-        specs.append((mags.astype(np.uint32), signs, band))
+        # Half the blocks carry fractional magnitude bits (lossy path).
+        fracs = (rng.integers(0, 128, size=(h, w)).astype(np.uint8)
+                 if i % 2 else None)
+        specs.append((mags.astype(np.uint32), signs, band, fracs))
     specs.append((np.zeros((64, 64), np.uint32),
-                  np.zeros((64, 64), bool), "HL"))  # all-zero block
+                  np.zeros((64, 64), bool), "HL", None))  # all-zero block
     return specs
 
 
 def test_native_matches_python_bit_exact(rng):
     specs = _random_blocks(rng)
     got = t1_batch.encode_blocks(specs)
-    for (m, s, band), blk in zip(specs, got):
-        ref = t1.encode_block(m, s, band)
+    for (m, s, band, f), blk in zip(specs, got):
+        ref = t1.encode_block(m, s, band, f)
         assert blk.data == ref.data
         assert blk.n_bitplanes == ref.n_bitplanes
         assert len(blk.passes) == len(ref.passes)
@@ -48,7 +51,7 @@ def test_native_matches_python_bit_exact(rng):
 
 def test_python_fallback_when_disabled(rng, monkeypatch):
     specs = _random_blocks(rng, n=2)
-    ref = [t1.encode_block(m, s, b) for m, s, b in specs]
+    ref = [t1.encode_block(m, s, b, f) for m, s, b, f in specs]
     monkeypatch.setattr(native, "load", lambda: None)
     got = t1_batch.encode_blocks(specs)
     for g, r in zip(got, ref):
