@@ -9,10 +9,9 @@ SURVEY.md §7 "hard parts" #1).
 This module is the pure-Python reference implementation: ground truth for
 tests and for the native C++ coder (bucketeer_tpu/native/t1.cpp) that the
 production path uses, with code-blocks fanned out across host threads
-while the TPU computes the next tile's transforms. The Pallas front-end
-(codec/pallas) computes bit-plane significance maps on-device; the
-sequential MQ state machine stays on host (it is inherently serial per
-block — a property of the codestream format, not of the implementation).
+while the TPU computes the next tile's transforms. The sequential MQ
+state machine stays on host (it is inherently serial per block — a
+property of the codestream format, not of the implementation).
 
 Code-blocks are embarrassingly parallel: nothing here shares state across
 blocks, which is exactly what both the C++ thread pool and the device
