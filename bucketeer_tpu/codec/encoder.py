@@ -32,11 +32,12 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from . import codestream as cs
+from . import frontend
 from . import jp2 as jp2box
 from . import rate as rate_mod
 from . import t1, t1_batch, t2
 from .dwt import synthesis_gains
-from .pipeline import TilePlan, extract_bands, make_plan, run_tiles
+from .pipeline import TilePlan, make_plan
 from .quant import GUARD_BITS, SubbandQuant
 
 CBLK_EXP = 6  # 64x64 code-blocks (reference recipe Cblk={64,64})
@@ -233,10 +234,32 @@ class _Band:
                 self.by0 >> CBLK_EXP, ((self.by1 - 1) >> CBLK_EXP) + 1)
 
 
+def _grid_aligned(plan: TilePlan, origin: tuple) -> bool:
+    """True when every sub-band block of a tile at ``origin`` lands on
+    the global 64-grid exactly where the device front-end's band-local
+    blockification puts it (no global cell boundary cuts a band's
+    interior). Holds for power-of-two tile grids; odd tile sizes fall
+    back to the host Tier-1 path (_legacy_tier1)."""
+    y0, x0 = origin
+    tcx1, tcy1 = x0 + plan.tile_w, y0 + plan.tile_h
+    cb = 1 << CBLK_EXP
+    for slot in plan.slots:
+        bx0, bx1, by0, by1 = _band_rect(x0, tcx1, y0, tcy1,
+                                        slot.resolution, slot.name,
+                                        plan.levels)
+        if (by1 - by0, bx1 - bx0) != (slot.h, slot.w):
+            return False
+        if by0 % cb and (by0 % cb) + slot.h > cb:
+            return False
+        if bx0 % cb and (bx0 % cb) + slot.w > cb:
+            return False
+    return True
+
+
 def _collect_blocks(band: _Band, specs: list, dests: list) -> None:
-    """Queue this band's code-blocks (global 64-grid cells intersecting
-    the tile-band rect — anchored at 0 in *global* band coordinates, per
-    T.800 B.7) into the image-wide Tier-1 batch."""
+    """Queue a band's code-blocks (global 64-grid cells intersecting the
+    tile-band rect, T.800 B.7) into the host Tier-1 batch — the legacy
+    path for tile grids the device front-end cannot blockify."""
     cx0, cx1, cy0, cy1 = band.cell_range
     for cy in range(cy0, cy1):
         for cx in range(cx0, cx1):
@@ -251,32 +274,47 @@ def _collect_blocks(band: _Band, specs: list, dests: list) -> None:
             dests.append((band, cy, cx))
 
 
-def _tile_bands(planes: np.ndarray, plan: TilePlan, origin: tuple,
-                specs: list, dests: list):
-    """(C, h, w) coefficient planes -> [component][resolution] band lists
-    in global coordinates, queueing code-block inputs."""
+def _tile_bands(plan: TilePlan, origin: tuple):
+    """Band geometry for one tile in global coordinates.
+
+    Returns (comp_res, band_of_slot): comp_res is the
+    [component][resolution] band-list structure Tier-2 walks;
+    band_of_slot maps (comp, slot_index) to its _Band so the device
+    front-end's canonical block order (frontend.layout_for) can be
+    joined to Tier-2's cells. Also asserts that the tile origin puts
+    every code-block on the global 64-grid exactly where the device's
+    local-grid blockification put it."""
     y0, x0 = origin
     tcx1, tcy1 = x0 + plan.tile_w, y0 + plan.tile_h
     comp_res = []
-    for c in range(planes.shape[0]):
-        resolutions = []
-        for res_bands in extract_bands(planes[c], plan):
-            bands = []
-            for slot, mags, signs, fracs in res_bands:
-                bx0, bx1, by0, by1 = _band_rect(
-                    x0, tcx1, y0, tcy1, slot.resolution, slot.name,
-                    plan.levels)
-                assert (by1 - by0, bx1 - bx0) == (slot.h, slot.w), (
-                    f"band {slot.name}@r{slot.resolution}: global rect "
-                    f"{(by1 - by0, bx1 - bx0)} != local {(slot.h, slot.w)}"
-                    " — tile origin not aligned for this level count")
-                band = _Band(slot.name, slot.resolution, c, slot.quant,
-                             bx0, bx1, by0, by1, mags, signs, fracs)
-                _collect_blocks(band, specs, dests)
-                bands.append(band)
-            resolutions.append(bands)
+    band_of_slot = {}
+    for c in range(plan.n_comps):
+        resolutions = [[] for _ in range(plan.levels + 1)]
+        for si, slot in enumerate(plan.slots):
+            bx0, bx1, by0, by1 = _band_rect(
+                x0, tcx1, y0, tcy1, slot.resolution, slot.name,
+                plan.levels)
+            assert (by1 - by0, bx1 - bx0) == (slot.h, slot.w), (
+                f"band {slot.name}@r{slot.resolution}: global rect "
+                f"{(by1 - by0, bx1 - bx0)} != local {(slot.h, slot.w)}"
+                " — tile origin not aligned for this level count")
+            # The device blockifies on the band-local 64-grid; Tier-2
+            # cells live on the *global* 64-grid. They coincide exactly
+            # when no global cell boundary cuts the band interior —
+            # guaranteed for power-of-two tile grids (origin offsets are
+            # multiples of the band size or of 64), asserted here.
+            assert (by0 % (1 << CBLK_EXP) == 0
+                    or (by0 % (1 << CBLK_EXP)) + slot.h <= (1 << CBLK_EXP)
+                    ), "tile origin splits code-blocks vertically"
+            assert (bx0 % (1 << CBLK_EXP) == 0
+                    or (bx0 % (1 << CBLK_EXP)) + slot.w <= (1 << CBLK_EXP)
+                    ), "tile origin splits code-blocks horizontally"
+            band = _Band(slot.name, slot.resolution, c, slot.quant,
+                         bx0, bx1, by0, by1, None, None, None)
+            resolutions[slot.resolution].append(band)
+            band_of_slot[(c, si)] = band
         comp_res.append(resolutions)
-    return comp_res
+    return comp_res, band_of_slot
 
 
 def _block_layers(blk: t1.CodedBlock,
@@ -445,6 +483,71 @@ def _band_weight(slot, gains) -> float:
     return (slot.quant.delta * g) ** 2
 
 
+def _legacy_tier1(groups: dict, plans: dict, img: np.ndarray,
+                  params: EncodeParams, bitdepth: int, n_comps: int,
+                  used_mct: bool, gains, weight_of_slot: dict):
+    """Host-side Tier-1 for tile grids the device front-end cannot
+    blockify (sub-bands straddling global 64-grid cells, i.e.
+    non-power-of-two tile sizes): raw coefficient planes come back from
+    the device and code-blocks are sliced on the host, clipped to the
+    global cell grid. Returns (tile_records, coded blocks, weights,
+    qcd_values)."""
+    from .pipeline import extract_bands, run_tiles
+
+    specs: list = []
+    dests: list = []
+    tile_records = []
+    qcd_values = None
+    norms = _RCT_NORMS if params.lossless else _ICT_NORMS
+    for (th, tw), members in groups.items():
+        plan = plans[(th, tw)]
+        batch = np.stack([img[y0:y0 + th, x0:x0 + tw]
+                          for _, y0, x0 in members])
+        planes = run_tiles(plan, batch)
+        if qcd_values is None:
+            qcd_values = _qcd_values(plan)
+        for s in plan.slots:
+            weight_of_slot.setdefault((s.resolution, s.name),
+                                      _band_weight(s, gains))
+        for (tidx, y0, x0), tile_planes in zip(members, planes):
+            tcx1, tcy1 = x0 + plan.tile_w, y0 + plan.tile_h
+            comp_res = []
+            for c in range(plan.n_comps):
+                resolutions = []
+                for res_bands in extract_bands(tile_planes[c], plan):
+                    bands = []
+                    for slot, mags, signs, fracs in res_bands:
+                        bx0, bx1, by0, by1 = _band_rect(
+                            x0, tcx1, y0, tcy1, slot.resolution,
+                            slot.name, plan.levels)
+                        assert (by1 - by0, bx1 - bx0) == (slot.h,
+                                                          slot.w), (
+                            "tile origin not aligned for this level "
+                            "count")
+                        band = _Band(slot.name, slot.resolution, c,
+                                     slot.quant, bx0, bx1, by0, by1,
+                                     mags, signs, fracs)
+                        _collect_blocks(band, specs, dests)
+                        bands.append(band)
+                    resolutions.append(bands)
+                comp_res.append(resolutions)
+            tile_records.append((tidx, (y0, x0), plan, comp_res))
+
+    blocks = []
+    weights = []
+    for (band, cy, cx), blk in zip(dests, t1_batch.encode_blocks(specs)):
+        band.blocks[(cy, cx)] = blk
+        blocks.append(blk)
+        cw = norms[band.comp] ** 2 if used_mct else 1.0
+        weights.append(weight_of_slot[(band.res, band.name)] * cw)
+    for _, _, _, comp_res in tile_records:
+        for resolutions in comp_res:
+            for bands in resolutions:
+                for band in bands:
+                    band.mags = band.signs = band.fracs = None
+    return tile_records, blocks, weights, qcd_values
+
+
 def encode_array(img: np.ndarray, bitdepth: int = 8,
                  params: EncodeParams | None = None) -> bytes:
     """Encode a (H, W) or (H, W, 3) array into a raw JPEG 2000 codestream."""
@@ -480,59 +583,146 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
             groups.setdefault((th, tw), []).append(
                 (ty * n_tiles_x + tx, y0, x0))
 
-    # Phase 1: device transforms (batched per shape group) and code-block
-    # collection across the whole image.
-    specs: list = []
-    dests: list = []
-    tile_records = []
-    qcd_values = None
     gains = synthesis_gains(levels, params.lossless)
     weight_of_slot: dict = {}
+    target = None
+    if params.rate is not None and not params.lossless:
+        target = params.rate * w * h / 8.0
+    norms = _RCT_NORMS if params.lossless else _ICT_NORMS
+    plans = {shape: make_plan(shape[0], shape[1], n_comps, levels,
+                              params.lossless, bitdepth, params.base_delta,
+                              use_mct=used_mct) for shape in groups}
+
+    if not all(_grid_aligned(plans[shape], (y0, x0))
+               for shape, members in groups.items()
+               for _, y0, x0 in members):
+        # Odd tile grids: host-side block slicing (no device packing).
+        tile_records, all_blocks, block_weights, qcd_values = \
+            _legacy_tier1(groups, plans, img, params, bitdepth, n_comps,
+                          used_mct, gains, weight_of_slot)
+        assign_index = {id(b): i for i, b in enumerate(all_blocks)}
+        return _finish(img, params, tile_records, all_blocks,
+                       block_weights, assign_index, qcd_values, used_mct,
+                       bitdepth, n_comps, levels, tile, target)
+
+    # Phase A: device front-end per shape group — fused transform,
+    # blockification, per-plane stats, bit-plane bitmaps packed on
+    # device (codec/frontend.py). Only the small stats come back here;
+    # the bitmaps stay in HBM until the floors are known.
+    tile_records = []
+    qcd_values = None
+    group_runs: list = []    # (plan, result, dests, hs, ws, bands, wts, ns)
     for (th, tw), members in groups.items():
-        plan = make_plan(th, tw, n_comps, levels, params.lossless, bitdepth,
-                         params.base_delta, use_mct=used_mct)
+        plan = plans[(th, tw)]
         batch = np.stack([img[y0:y0 + th, x0:x0 + tw]
                           for _, y0, x0 in members])
-        planes = run_tiles(plan, batch)              # (B, C, th, tw)
+        fres = frontend.run_frontend(plan, batch)
         if qcd_values is None:
             qcd_values = _qcd_values(plan)
         for s in plan.slots:
             weight_of_slot.setdefault((s.resolution, s.name),
                                       _band_weight(s, gains))
-        for (tidx, y0, x0), tile_planes in zip(members, planes):
-            comp_res = _tile_bands(tile_planes, plan, (y0, x0), specs,
-                                   dests)
+        layout = fres.layout
+        dests, hs, ws, bandnames, wts, ns = [], [], [], [], [], []
+        for (tidx, y0, x0) in members:
+            comp_res, band_of_slot = _tile_bands(plan, (y0, x0))
             tile_records.append((tidx, (y0, x0), plan, comp_res))
+            for m in layout.metas:
+                band = band_of_slot[(m.comp, m.slot_i)]
+                cx0, _, cy0, _ = band.cell_range
+                dests.append((band, cy0 + m.iy, cx0 + m.ix))
+                hs.append(m.h)
+                ws.append(m.w)
+                bandnames.append(band.name)
+                cw = norms[m.comp] ** 2 if used_mct else 1.0
+                wts.append(weight_of_slot[(band.res, band.name)] * cw)
+                ns.append(m.h * m.w)
+        group_runs.append((plan, fres, dests, np.asarray(hs, np.int32),
+                           np.asarray(ws, np.int32), bandnames,
+                           np.asarray(wts), np.asarray(ns)))
 
-    # Phase 2: one Tier-1 batch over every code-block in the image (native
-    # thread pool when available).
-    all_blocks: list = []
+    # Bit-plane floors: with a rate target, skip coding (and transfer)
+    # of planes PCRD-opt would discard; without one, code everything.
+    def group_floors(margin: float) -> list:
+        if target is None:
+            return [np.zeros(fr.n_blocks, np.int32)
+                    for _, fr, *_ in group_runs]
+        # Plane capacity could in principle differ between shape
+        # groups; pad the per-plane stats to the widest.
+        pmax = max(fr.layout.P for _, fr, *_ in group_runs)
+
+        def padp(a):
+            return np.pad(a, ((0, 0), (0, pmax - a.shape[1])))
+
+        nbps = np.concatenate([fr.nbps for _, fr, *_ in group_runs])
+        newsig = np.concatenate([padp(fr.newsig)
+                                 for _, fr, *_ in group_runs])
+        sigd = np.concatenate([padp(fr.sigd) for _, fr, *_ in group_runs])
+        refd = np.concatenate([padp(fr.refd) for _, fr, *_ in group_runs])
+        wts = np.concatenate([g[6] for g in group_runs])
+        ns = np.concatenate([g[7] for g in group_runs])
+        floors = rate_mod.estimate_floors(nbps, newsig, sigd, refd,
+                                          wts, ns, target, margin)
+        out, ofs = [], 0
+        for _, fr, *_ in group_runs:
+            out.append(floors[ofs:ofs + fr.n_blocks])
+            ofs += fr.n_blocks
+        return out
+
+    # Phase B: compact exactly the needed bitmap rows on device, copy
+    # them host-side, and run native Tier-1 over the packed payload.
+    # If the floors were too aggressive for the byte target (estimator
+    # undershoot), lower them and redo — PCRD needs enough passes to
+    # spend the budget.
+    margin = 3.0
+    for attempt in range(3):
+        floors_by_group = group_floors(margin)
+        all_blocks = []
+        for (plan, fr, dests, hs, ws, bandnames, wts, ns), floors in zip(
+                group_runs, floors_by_group):
+            src, offsets = frontend.payload_plan(fr.nbps, floors,
+                                                 fr.layout.P)
+            payload = frontend.fetch_payload(fr, src)
+            blocks = t1_batch.encode_packed(payload, offsets, fr.nbps,
+                                            floors, hs, ws, bandnames)
+            if not params.lossless:
+                _correct_distortions(blocks, fr)
+            all_blocks.append(blocks)
+        if target is None:
+            break
+        avail = sum(len(b.data) for blocks in all_blocks for b in blocks)
+        if avail >= 1.05 * target:
+            break
+        margin *= 4.0
+    group_runs_meta = [(g[2], g[6]) for g in group_runs]
+    del group_runs        # release the device-side bitmap rows
+
+    all_coded: list = []
     block_weights: list = []
     assign_index: dict = {}     # id(CodedBlock) -> index
-    for (band, cy, cx), blk in zip(dests, t1_batch.encode_blocks(specs)):
-        assert blk.n_bitplanes <= band.q.n_bitplanes, (
-            f"block bitplanes {blk.n_bitplanes} exceed Mb "
-            f"{band.q.n_bitplanes} in {band.name}")
-        band.blocks[(cy, cx)] = blk
-        assign_index[id(blk)] = len(all_blocks)
-        all_blocks.append(blk)
-        if used_mct:
-            norms = _RCT_NORMS if params.lossless else _ICT_NORMS
-            cw = norms[band.comp] ** 2
-        else:
-            cw = 1.0
-        block_weights.append(weight_of_slot[(band.res, band.name)] * cw)
-    # Coefficients are fully entropy-coded now; drop them so a huge image
-    # doesn't hold every tile's magnitude/sign planes through Tier-2.
-    specs.clear()
-    for _, _, _, comp_res in tile_records:
-        for resolutions in comp_res:
-            for bands in resolutions:
-                for band in bands:
-                    band.mags = band.signs = band.fracs = None
+    for (dests, wts), blocks in zip(group_runs_meta, all_blocks):
+        for (band, cy, cx), blk, bw in zip(dests, blocks, wts):
+            assert blk.n_bitplanes <= band.q.n_bitplanes, (
+                f"block bitplanes {blk.n_bitplanes} exceed Mb "
+                f"{band.q.n_bitplanes} in {band.name}")
+            band.blocks[(cy, cx)] = blk
+            assign_index[id(blk)] = len(all_coded)
+            all_coded.append(blk)
+            block_weights.append(bw)
+    all_blocks = all_coded
+    return _finish(img, params, tile_records, all_blocks, block_weights,
+                   assign_index, qcd_values, used_mct, bitdepth, n_comps,
+                   levels, tile, target)
 
-    # Phase 3: PCRD layer allocation + Tier-2, iterated once or twice so
-    # the assembled file size (headers included) lands on the target.
+
+def _finish(img: np.ndarray, params: EncodeParams, tile_records: list,
+            all_blocks: list, block_weights: list, assign_index: dict,
+            qcd_values: list, used_mct: bool, bitdepth: int, n_comps: int,
+            levels: int, tile: int, target: float | None) -> bytes:
+    """PCRD layer allocation + Tier-2 + codestream assembly, iterated a
+    few times so the assembled file size (headers included) lands on the
+    byte target."""
+    h, w = img.shape[:2]
     exps = _precinct_exps(params, levels)
     segs = [
         cs.siz(w, h, n_comps, bitdepth, tile, tile),
@@ -563,9 +753,6 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
                                      n_comps))
         return cs.assemble_parts(segs, parts)
 
-    target = None
-    if params.rate is not None and not params.lossless:
-        target = params.rate * w * h / 8.0
     if target is None:
         return build(None)
 
@@ -579,6 +766,40 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         budget = max(1024.0, budget - err)
         out = build(budget)
     return out
+
+
+def _correct_distortions(blocks: list, fres) -> None:
+    """Replace the host coder's fractionless per-pass distortion
+    estimates with the device front-end's exact per-plane sums.
+
+    The packed payload ships no fractional-magnitude bits (and, under a
+    bit-plane floor, no low integer bits), so native Tier-1's midpoint
+    estimates are biased; the device computed the exact per-plane
+    significance/refinement distortion totals from the full fixed-point
+    coefficients (frontend._frontend_body). Pass-level granularity is
+    recovered by scaling each pass in plane p by the exact/estimated
+    plane-total ratio for its kind (sig = SPP+CP, ref = MRP)."""
+    P = fres.layout.P
+    for bi, blk in enumerate(blocks):
+        if not blk.passes:
+            continue
+        est_sig = [0.0] * P
+        est_ref = [0.0] * P
+        for info in blk.passes:
+            if info.pass_type == 1:
+                est_ref[info.bitplane] += info.dist_reduction
+            else:
+                est_sig[info.bitplane] += info.dist_reduction
+        for info in blk.passes:
+            p = info.bitplane
+            est = est_ref[p] if info.pass_type == 1 else est_sig[p]
+            exact = (fres.refd[bi, p] if info.pass_type == 1
+                     else fres.sigd[bi, p])
+            if est > 0.0 and exact >= 0.0:
+                info.dist_reduction *= exact / est
+            # A zero estimate with nonzero exact distortion cannot be
+            # apportioned; keep the estimate (it is zero) — the hull
+            # treats the pass as free distortion-wise either way.
 
 
 def _qcd_values(plan: TilePlan) -> list:

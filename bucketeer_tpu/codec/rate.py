@@ -20,6 +20,91 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import numpy as np
+
+# Per-plane pass-size model used to pick bit-plane floors *before*
+# Tier-1 runs (estimate_floors): estimated coded bits for one plane of
+# one block ≈ A_INSIG per still-insignificant sample scanned (ZC
+# decisions, mostly run-length-collapsed zeros) + A_SIG per newly
+# significant sample (the 1-decision plus sign) + A_REF per refinement
+# decision. Calibrated by least squares against actual per-plane MQ pass
+# lengths on photographic content (median est/actual 0.95, p5 0.78,
+# p95 2.0; guardrail:
+# tests/test_codec_roundtrip.py::test_floor_estimator_conservative).
+# These only gate what ships to the host — PCRD uses
+# real measured lengths — so accuracy affects transfer size, not
+# correctness; the safety margin covers the residual error.
+A_INSIG = 0.18
+A_SIG = 2.8
+A_REF = 0.95
+
+
+def estimate_floors(nbps: np.ndarray, newsig: np.ndarray,
+                    sigd: np.ndarray, refd: np.ndarray,
+                    weights: np.ndarray, n_samples: np.ndarray,
+                    target_bytes: float, margin: float = 3.0) -> np.ndarray:
+    """Choose a per-block lowest bit-plane to code, from device front-end
+    statistics (codec/frontend.py), so Tier-1 skips work (and the device
+    skips transfer) that PCRD-opt would discard anyway.
+
+    nbps (N,), newsig/sigd/refd (N, P), weights (N,) PCRD distortion
+    weights, n_samples (N,) true samples per block. Picks the largest
+    slope threshold whose contiguous-from-MSB plane selection costs
+    ~margin x target_bytes by the pass-size model above, then grants one
+    extra plane of safety. Returns floors (N,); a floor == nbp marks a
+    block that ships nothing (it would not survive rate control).
+    """
+    n, P = newsig.shape
+    planes = np.arange(P)
+    valid = planes[None, :] < nbps[:, None]
+    # Samples already significant when plane p is coded = those whose
+    # MSB sits in a higher plane.
+    cum = np.cumsum(newsig[:, ::-1], axis=1)[:, ::-1]
+    sig_before = cum - newsig
+    insig = np.maximum(0, n_samples[:, None] - sig_before)
+    est_bits = A_INSIG * insig + A_SIG * newsig + A_REF * sig_before
+    est_bytes = np.where(valid, np.maximum(est_bits / 8.0, 1.0), 0.0)
+    dist = np.where(valid, np.maximum((sigd + refd), 0.0)
+                    * weights[:, None], 0.0)
+    # Contiguity from the MSB with amortization: a plane's worth is the
+    # *average* slope of everything from the MSB down to it (a dud plane
+    # must not orphan a valuable one below it — the PCRD hull amortizes
+    # such passes the same way). Running-min keeps the include set
+    # contiguous when the average wobbles.
+    cum_d = np.cumsum(dist[:, ::-1], axis=1)
+    cum_b = np.cumsum(est_bytes[:, ::-1], axis=1)
+    avg = (cum_d / np.maximum(cum_b, 1e-9))[:, ::-1]
+    slope_mono = np.where(valid, avg, np.inf)[:, ::-1]
+    slope_mono = np.minimum.accumulate(slope_mono, axis=1)[:, ::-1]
+    slope_mono = np.where(valid, slope_mono, 0.0)
+    cum_b = cum_b[:, ::-1]      # cum_b[b, p] = est bytes for planes >= p
+
+    budget = margin * target_bytes
+    pos = slope_mono[valid & (slope_mono > 0)]
+    if pos.size == 0:
+        return nbps.copy()
+
+    def cost_at(lam: float) -> float:
+        inc = valid & (slope_mono >= lam)
+        any_inc = inc.any(axis=1)
+        lowest = np.argmax(inc, axis=1)
+        return float(cum_b[np.nonzero(any_inc)[0], lowest[any_inc]].sum())
+
+    lo, hi = float(pos.min()) * 0.5, float(pos.max()) * 2.0
+    for _ in range(40):
+        lam = (lo * hi) ** 0.5
+        if cost_at(lam) > budget:
+            lo = lam
+        else:
+            hi = lam
+    included = valid & (slope_mono >= hi)
+    any_inc = included.any(axis=1)
+    # One extra plane of safety below the estimated cut for live blocks;
+    # blocks with nothing over the threshold ship nothing at all.
+    lowest = np.argmax(included, axis=1)
+    floors = np.where(any_inc, np.maximum(0, lowest - 1), nbps)
+    return np.minimum(floors, nbps).astype(np.int32)
+
 
 @dataclass
 class LayerAssignment:

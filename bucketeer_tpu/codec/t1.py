@@ -94,14 +94,18 @@ class CodedBlock:
 
 
 def encode_block(mags: np.ndarray, signs: np.ndarray, band: str,
-                 fracs: np.ndarray | None = None) -> CodedBlock:
+                 fracs: np.ndarray | None = None,
+                 floor: int = 0) -> CodedBlock:
     """Encode one code-block.
 
     mags: (h, w) uint32 magnitudes (quantizer indices); signs: (h, w)
     bool/int, nonzero = negative; band: LL/HL/LH/HH (context-table class);
     fracs: optional (h, w) uint8 fractional magnitude bits (FRAC_BITS of
     |c|/delta below the index) for exact distortion estimation — None
-    means the indices are exact (reversible path).
+    means the indices are exact (reversible path); floor: lowest coded
+    bit-plane (planes below it are omitted from the pass list — a
+    truncation the rate allocator would have made; the caller must have
+    zeroed the corresponding magnitude bits).
     """
     h, w = mags.shape
     maxv = int(mags.max()) if mags.size else 0
@@ -192,7 +196,7 @@ def encode_block(mags: np.ndarray, signs: np.ndarray, band: str,
     passes: list[PassInfo] = []
     dist = 0.0
 
-    for p in range(nbps - 1, -1, -1):
+    for p in range(nbps - 1, floor - 1, -1):
         bit = 1 << p
         first_plane = p == nbps - 1
 

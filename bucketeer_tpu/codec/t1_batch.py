@@ -24,6 +24,74 @@ def default_threads() -> int:
     return max(1, (os.cpu_count() or 2) - 1)
 
 
+def _collect(lib, handle, n: int) -> list:
+    """Pull a native T1Result handle into [t1.CodedBlock]."""
+    try:
+        nbps = np.zeros(n, dtype=np.int32)
+        npasses = np.zeros(n, dtype=np.int32)
+        nbytes = np.zeros(n, dtype=np.int64)
+        lib.t1_block_sizes(handle, nbps.ctypes.data, npasses.ctypes.data,
+                           nbytes.ctypes.data)
+        out = []
+        for i in range(n):
+            np_i, nb_i = int(npasses[i]), int(nbytes[i])
+            data = np.empty(max(nb_i, 1), dtype=np.uint8)
+            ptype = np.zeros(max(np_i, 1), dtype=np.int32)
+            pplane = np.zeros(max(np_i, 1), dtype=np.int32)
+            plen = np.zeros(max(np_i, 1), dtype=np.int64)
+            pdist = np.zeros(max(np_i, 1), dtype=np.float64)
+            lib.t1_block_get(handle, i, data.ctypes.data, ptype.ctypes.data,
+                             pplane.ctypes.data, plen.ctypes.data,
+                             pdist.ctypes.data)
+            passes = [t1.PassInfo(int(ptype[k]), int(pplane[k]),
+                                  int(plen[k]), float(pdist[k]))
+                      for k in range(np_i)]
+            out.append(t1.CodedBlock(bytes(data[:nb_i].tobytes()),
+                                     int(nbps[i]), passes))
+        return out
+    finally:
+        lib.t1_result_free(handle)
+
+
+def encode_packed(payload: np.ndarray, offsets: np.ndarray,
+                  nbps: np.ndarray, floors: np.ndarray,
+                  hs: np.ndarray, ws: np.ndarray,
+                  bands: list) -> list:
+    """Tier-1 over the device front-end's packed bitmap payload
+    (codec/frontend.py): payload (R, 512) uint8 rows, offsets (n+1,)
+    row offsets per block, per-block nbps/floors/dims and band names.
+    Returns [t1.CodedBlock] in block order."""
+    n = len(nbps)
+    lib = native.load()
+    cls = np.array([_BAND_CLS[b] for b in bands], dtype=np.int32)
+    if lib is not None and n:
+        # Bind every converted array to a local: .ctypes.data of an
+        # unnamed temporary is a dangling pointer by call time.
+        payload = np.ascontiguousarray(payload, dtype=np.uint8)
+        offs = np.ascontiguousarray(offsets[:n], dtype=np.int64)
+        nbps_c = np.ascontiguousarray(nbps, dtype=np.int32)
+        floors_c = np.ascontiguousarray(floors, dtype=np.int32)
+        hs_c = np.ascontiguousarray(hs, dtype=np.int32)
+        ws_c = np.ascontiguousarray(ws, dtype=np.int32)
+        handle = lib.t1_encode_packed(
+            n, payload.ctypes.data, offs.ctypes.data, nbps_c.ctypes.data,
+            floors_c.ctypes.data, hs_c.ctypes.data, ws_c.ctypes.data,
+            cls.ctypes.data, default_threads())
+        return _collect(lib, handle, n)
+    out = []
+    for i in range(n):
+        if nbps[i] <= floors[i]:
+            out.append(t1.CodedBlock(b"", 0))
+            continue
+        from . import frontend
+        mags, negs = frontend.unpack_block(payload, int(offsets[i]),
+                                           int(nbps[i]), int(floors[i]),
+                                           int(hs[i]), int(ws[i]))
+        out.append(t1.encode_block(mags, negs, bands[i],
+                                   floor=int(floors[i])))
+    return out
+
+
 def encode_blocks(specs: list) -> list:
     """specs: [(mags uint32 (h,w), signs bool (h,w), band_name,
     fracs uint8 (h,w) | None)] -> [t1.CodedBlock] in order."""
@@ -59,28 +127,4 @@ def encode_blocks(specs: list) -> list:
         fracs.ctypes.data if fracs is not None else None,
         offsets.ctypes.data,
         hs.ctypes.data, ws.ctypes.data, cls.ctypes.data, default_threads())
-    try:
-        nbps = np.zeros(n, dtype=np.int32)
-        npasses = np.zeros(n, dtype=np.int32)
-        nbytes = np.zeros(n, dtype=np.int64)
-        lib.t1_block_sizes(handle, nbps.ctypes.data, npasses.ctypes.data,
-                           nbytes.ctypes.data)
-        out = []
-        for i in range(n):
-            np_i, nb_i = int(npasses[i]), int(nbytes[i])
-            data = np.empty(max(nb_i, 1), dtype=np.uint8)
-            ptype = np.zeros(max(np_i, 1), dtype=np.int32)
-            pplane = np.zeros(max(np_i, 1), dtype=np.int32)
-            plen = np.zeros(max(np_i, 1), dtype=np.int64)
-            pdist = np.zeros(max(np_i, 1), dtype=np.float64)
-            lib.t1_block_get(handle, i, data.ctypes.data, ptype.ctypes.data,
-                             pplane.ctypes.data, plen.ctypes.data,
-                             pdist.ctypes.data)
-            passes = [t1.PassInfo(int(ptype[k]), int(pplane[k]),
-                                  int(plen[k]), float(pdist[k]))
-                      for k in range(np_i)]
-            out.append(t1.CodedBlock(bytes(data[:nb_i].tobytes()),
-                                     int(nbps[i]), passes))
-        return out
-    finally:
-        lib.t1_result_free(handle)
+    return _collect(lib, handle, n)

@@ -20,7 +20,7 @@ from pathlib import Path
 
 _DIR = Path(__file__).resolve().parent
 _LIB_PATH = _DIR / "libbucketeer_t1.so"
-_ABI_VERSION = 2     # must match t1_abi_version() in t1.cpp
+_ABI_VERSION = 3     # must match t1_abi_version() in t1.cpp
 _lib = None
 _tried = False
 
@@ -78,6 +78,11 @@ def load():
             return None
     lib.t1_encode_blocks.restype = ctypes.c_void_p
     lib.t1_encode_blocks.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int]
+    lib.t1_encode_packed.restype = ctypes.c_void_p
+    lib.t1_encode_packed.argtypes = [
         ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_int]

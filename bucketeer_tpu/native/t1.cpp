@@ -197,9 +197,15 @@ struct BlockOut {
 // Band class: 0 = LL/LH table, 1 = HH table, 2 = HL (LL/LH with H/V swap).
 // fracs: optional FRAC_BITS(=7) fractional magnitude bits below the index
 // (quantize_fp), null when indices are exact (reversible path).
+// floor: lowest bit-plane to code (0 = all). Planes below the floor are
+// simply absent from the pass list — a valid truncation the rate
+// allocator would have made anyway (the caller guarantees the floor sits
+// below the final PCRD cut); the magnitudes' low bits must already be
+// zero there (the packed payload never ships them).
 static void encode_block(const uint32_t* mags, const uint8_t* negs,
                          const uint8_t* fracs,
-                         int h, int w, int bandcls, BlockOut& out) {
+                         int h, int w, int bandcls, int floor,
+                         BlockOut& out) {
     uint32_t maxv = 0;
     const int n = h * w;
     for (int i = 0; i < n; i++) maxv = mags[i] > maxv ? mags[i] : maxv;
@@ -287,7 +293,7 @@ static void encode_block(const uint32_t* mags, const uint8_t* negs,
     };
 
     double dist;
-    for (int p = nbps - 1; p >= 0; p--) {
+    for (int p = nbps - 1; p >= floor; p--) {
         const uint32_t bit = 1u << p;
         const bool first_plane = p == nbps - 1;
 
@@ -399,32 +405,14 @@ struct T1Result {
     std::vector<BlockOut> blocks;
 };
 
-}  // namespace
-
-extern "C" {
-
-// Bumped whenever any exported signature changes; the Python loader
-// refuses a library whose version doesn't match, so a stale prebuilt
-// .so (deployment images may prune t1.cpp) fails loudly instead of
-// misreading the new argument layout.
-int32_t t1_abi_version() { return 2; }
-
-T1Result* t1_encode_blocks(int n_blocks,
-                           const uint32_t* mags, const uint8_t* negs,
-                           const uint8_t* fracs,
-                           const int64_t* offsets,
-                           const int32_t* hs, const int32_t* ws,
-                           const int32_t* bandcls, int n_threads) {
-    auto* res = new T1Result();
-    res->blocks.resize(n_blocks);
+template <typename F>
+void run_pool(int n_blocks, int n_threads, F&& body) {
     std::atomic<int> next(0);
     auto worker = [&]() {
         for (;;) {
             int i = next.fetch_add(1);
             if (i >= n_blocks) break;
-            encode_block(mags + offsets[i], negs + offsets[i],
-                         fracs ? fracs + offsets[i] : nullptr,
-                         hs[i], ws[i], bandcls[i], res->blocks[i]);
+            body(i);
         }
     };
     if (n_threads <= 1 || n_blocks <= 1) {
@@ -435,6 +423,69 @@ T1Result* t1_encode_blocks(int n_blocks,
         for (int t = 0; t < nt; t++) pool.emplace_back(worker);
         for (auto& th : pool) th.join();
     }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Bumped whenever any exported signature changes; the Python loader
+// refuses a library whose version doesn't match, so a stale prebuilt
+// .so (deployment images may prune t1.cpp) fails loudly instead of
+// misreading the new argument layout.
+int32_t t1_abi_version() { return 3; }
+
+T1Result* t1_encode_blocks(int n_blocks,
+                           const uint32_t* mags, const uint8_t* negs,
+                           const uint8_t* fracs,
+                           const int64_t* offsets,
+                           const int32_t* hs, const int32_t* ws,
+                           const int32_t* bandcls, int n_threads) {
+    auto* res = new T1Result();
+    res->blocks.resize(n_blocks);
+    run_pool(n_blocks, n_threads, [&](int i) {
+        encode_block(mags + offsets[i], negs + offsets[i],
+                     fracs ? fracs + offsets[i] : nullptr,
+                     hs[i], ws[i], bandcls[i], 0, res->blocks[i]);
+    });
+    return res;
+}
+
+// Packed-bitmap entry (the device front-end path, codec/frontend.py).
+// payload: concatenated 512-byte rows; block i's rows start at byte
+// offsets[i]*512: [sign bitmap][plane nbps[i]-1]...[plane floors[i]].
+// Bitmaps are 64x64 LSB-first: sample (y,x) -> byte y*8 + x/8, bit x%8;
+// a partial (h,w) block occupies the top-left corner. Blocks with
+// nbps <= floors ship no rows and code as empty.
+T1Result* t1_encode_packed(int n_blocks, const uint8_t* payload,
+                           const int64_t* offsets,
+                           const int32_t* nbps, const int32_t* floors,
+                           const int32_t* hs, const int32_t* ws,
+                           const int32_t* bandcls, int n_threads) {
+    auto* res = new T1Result();
+    res->blocks.resize(n_blocks);
+    run_pool(n_blocks, n_threads, [&](int i) {
+        const int nbp = nbps[i], floor = floors[i];
+        if (nbp <= floor) return;             // dead block: zero passes
+        const int h = hs[i], w = ws[i];
+        const uint8_t* rows = payload + offsets[i] * 512;
+        uint32_t mags[64 * 64];
+        uint8_t negs[64 * 64];
+        std::memset(mags, 0, sizeof(uint32_t) * h * w);
+        for (int y = 0; y < h; y++)
+            for (int x = 0; x < w; x++)
+                negs[y * w + x] = (rows[y * 8 + (x >> 3)] >> (x & 7)) & 1;
+        for (int j = 0, p = nbp - 1; p >= floor; j++, p--) {
+            const uint8_t* bm = rows + (1 + j) * 512;
+            for (int y = 0; y < h; y++)
+                for (int x = 0; x < w; x++)
+                    mags[y * w + x] |=
+                        (uint32_t)((bm[y * 8 + (x >> 3)] >> (x & 7)) & 1)
+                        << p;
+        }
+        encode_block(mags, negs, nullptr, h, w, bandcls[i], floor,
+                     res->blocks[i]);
+    });
     return res;
 }
 

@@ -162,3 +162,38 @@ def test_size_oracle(rng):
     data = encoder.encode_jp2(img, 8, EncodeParams(lossless=True, levels=3))
     assert len(data) > 1000
     assert data[:4] == bytes([0, 0, 0, 12])  # JP2 signature box
+
+
+def test_floor_estimator_conservative(rng, monkeypatch):
+    """Guardrail for the bit-plane floor estimator (rate.estimate_floors
+    and its A_INSIG/A_SIG/A_REF pass-size model): skipping planes the
+    rate allocator would discard must not change quality measurably
+    versus coding everything at the same byte target."""
+    from bucketeer_tpu.codec import rate as rate_mod
+
+    y, x = np.mgrid[0:256, 0:384]
+    lum = (110 + 70 * np.sin(x / 19.0) * np.cos(y / 13.0)
+           + 25 * ((x // 32 + y // 32) % 2))
+    img = np.clip(np.stack([lum + 10, lum * 0.92, lum * 0.85], -1)
+                  + rng.normal(0, 3, (256, 384, 3)), 0, 255).astype(np.uint8)
+    params = EncodeParams.kakadu_recipe(lossless=False, rate=3.0)
+    with_floors = encoder.encode_jp2(img, 8, params)
+    monkeypatch.setattr(
+        rate_mod, "estimate_floors",
+        lambda nbps, *a, **k: np.zeros_like(nbps))
+    without = encoder.encode_jp2(img, 8, params)
+    p_f = _psnr(_decode(with_floors), img)
+    p_0 = _psnr(_decode(without), img)
+    assert p_f >= p_0 - 0.1, (
+        f"floors cost quality: {p_f:.2f} vs {p_0:.2f} dB")
+
+
+def test_unaligned_tile_grid_falls_back(rng):
+    """Tile sizes whose sub-bands straddle global 64-grid cells can't use
+    the device front-end's blockification; the encoder must fall back to
+    host block slicing (encoder._legacy_tier1) and still produce a
+    decodable, bit-exact lossless stream."""
+    img = rng.integers(0, 256, size=(192, 192, 3), dtype=np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(
+        lossless=True, levels=2, tile_size=96))
+    np.testing.assert_array_equal(_decode(data), img)
