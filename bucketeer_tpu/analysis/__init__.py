@@ -1,0 +1,27 @@
+"""graftlint: JAX/TPU-aware static analysis + runtime contracts.
+
+Three legs, one goal — keep the fused device pipeline's invariants
+enforced instead of implied:
+
+- **Lint engine** (``python -m bucketeer_tpu.analysis``): AST rules for
+  host syncs and Python branches on tracers inside jit-compiled code,
+  float64 leakage, unsanctioned device-to-host copies, swallowed
+  exceptions in the engine/server handlers, empty packages, and a
+  ctypes <-> C++ ABI cross-check for the native Tier-1 coder.
+  See docs/analysis.md for every rule and the suppression syntax
+  (``# graftlint: disable=<rule>``).
+- **Contracts** (:func:`contract`): shape/dtype declarations on codec
+  entry points, enforced under tests, zero-cost in production.
+- **Retrace sentinel** (:mod:`retrace`): per-stage XLA compilation
+  counters so unexpected recompiles fail tests instead of silently
+  stalling the service.
+"""
+from .contracts import ContractViolation, contract, contracts_enabled
+from .findings import ERROR, WARNING, Finding
+from .lint import load_baseline, run_lint, write_baseline
+
+__all__ = [
+    "ContractViolation", "contract", "contracts_enabled",
+    "ERROR", "WARNING", "Finding",
+    "load_baseline", "run_lint", "write_baseline",
+]

@@ -1,0 +1,86 @@
+"""graftlint CLI: ``python -m bucketeer_tpu.analysis [--strict] [paths]``.
+
+Exit codes: 0 clean (in non-strict mode, warnings alone stay clean),
+1 findings, 2 bad invocation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .findings import ERROR
+from .lint import load_baseline, run_lint, write_baseline
+
+DEFAULT_BASELINE = ".graftlint-baseline.json"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m bucketeer_tpu.analysis",
+        description="JAX/TPU-aware lint for the bucketeer codebase")
+    parser.add_argument("paths", nargs="*",
+                        help="package directories to lint (default: the "
+                             "installed bucketeer_tpu package)")
+    parser.add_argument("--strict", action="store_true",
+                        help="warnings also fail the run")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: {DEFAULT_BASELINE} "
+                             "next to the linted package, if present)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record current findings as the baseline "
+                             "and exit 0")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    if args.paths:
+        roots = [Path(p) for p in args.paths]
+    else:
+        roots = [Path(__file__).resolve().parent.parent]
+    for root in roots:
+        if not root.is_dir():
+            print(f"not a directory: {root}", file=sys.stderr)
+            return 2
+
+    # One baseline file for the whole invocation (explicit --baseline,
+    # else next to the first root) so a --write-baseline round trip
+    # covers every linted root.
+    baseline_path = (Path(args.baseline) if args.baseline
+                     else roots[0].parent / DEFAULT_BASELINE)
+    baseline = (set() if args.write_baseline
+                else load_baseline(baseline_path)
+                if baseline_path.exists() else set())
+    findings = []
+    for root in roots:
+        findings += run_lint(root, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps([{
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "severity": f.severity, "message": f.message,
+            "fingerprint": f.fingerprint(),
+        } for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+
+    errors = sum(1 for f in findings if f.severity == ERROR)
+    warnings = len(findings) - errors
+    if findings and not args.as_json:
+        print(f"graftlint: {errors} error(s), {warnings} warning(s)")
+    if errors or (args.strict and warnings):
+        return 1
+    if not findings and not args.as_json:
+        print("graftlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

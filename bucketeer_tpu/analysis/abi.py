@@ -1,0 +1,115 @@
+"""Native-ABI cross-checker: ctypes bindings vs t1.cpp exports.
+
+``bucketeer_tpu/native/__init__.py`` binds a handful of ``extern "C"``
+symbols by hand and guards against layout drift with a single integer
+(``_ABI_VERSION`` vs ``t1_abi_version()``). Nothing enforced that the
+two sides actually agree until the process crashed at runtime; this
+checker parses both sides and turns drift into a lint failure:
+
+- ``abi-version-mismatch``: the Python ``_ABI_VERSION`` constant differs
+  from the value returned by ``t1_abi_version()`` in the C++ source.
+- ``abi-missing-export``: Python configures ``lib.<symbol>`` but the
+  C++ ``extern "C"`` block does not define it (a runtime
+  ``AttributeError`` waiting to happen).
+- ``abi-unbound-export``: the C++ side exports a symbol Python never
+  binds (dead export, or a binding someone forgot) — warning severity.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .findings import ERROR, WARNING, Finding
+
+VERSION_MISMATCH = "abi-version-mismatch"
+MISSING_EXPORT = "abi-missing-export"
+UNBOUND_EXPORT = "abi-unbound-export"
+
+# A C function definition at column 0: return type tokens then the name.
+_CPP_FN_RE = re.compile(r"(?m)^[A-Za-z_][\w]*\s*\*?\s+\*?(\w+)\s*\(")
+_CPP_VERSION_RE = re.compile(
+    r"t1_abi_version\s*\(\s*(?:void)?\s*\)\s*\{\s*return\s+(-?\d+)")
+_CPP_KEYWORDS = {"if", "for", "while", "switch", "return", "sizeof"}
+
+
+def parse_cpp_exports(cpp_text: str):
+    """(exported function names, abi version int or None)."""
+    start = cpp_text.find('extern "C"')
+    block = cpp_text[start:] if start >= 0 else ""
+    names = {m.group(1) for m in _CPP_FN_RE.finditer(block)}
+    names -= _CPP_KEYWORDS
+    m = _CPP_VERSION_RE.search(cpp_text)
+    version = int(m.group(1)) if m else None
+    return names, version
+
+
+def parse_python_bindings(py_text: str, filename: str = "<native>"):
+    """(_ABI_VERSION int or None, {symbols configured on ``lib``},
+    line of the version assignment)."""
+    tree = ast.parse(py_text, filename=filename)
+    version = None
+    version_line = 1
+    symbols: dict = {}        # name -> first line used
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and \
+                        target.id == "_ABI_VERSION" and \
+                        isinstance(node.value, ast.Constant) and \
+                        isinstance(node.value.value, int):
+                    version = node.value.value
+                    version_line = node.lineno
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "lib":
+            symbols.setdefault(node.attr, node.lineno)
+    return version, symbols, version_line
+
+
+def check_native(native_dir: Path, rel_to: Path | None = None) -> list:
+    """Cross-check one native package directory; returns findings."""
+    native_dir = Path(native_dir)
+    init = native_dir / "__init__.py"
+    cpp = native_dir / "t1.cpp"
+    if not init.exists() or not cpp.exists():
+        return []
+
+    def rel(p: Path) -> str:
+        if rel_to is not None:
+            try:
+                return str(p.resolve().relative_to(Path(rel_to).resolve()))
+            except ValueError:
+                pass
+        return str(p)
+
+    try:
+        py_version, symbols, version_line = parse_python_bindings(
+            init.read_text(encoding="utf-8"), str(init))
+        exports, cpp_version = parse_cpp_exports(
+            cpp.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError) as exc:
+        return [Finding("parse-error", rel(init), 1,
+                        f"ABI cross-check could not parse: {exc}", ERROR)]
+
+    findings = []
+    if py_version is not None and cpp_version is not None and \
+            py_version != cpp_version:
+        findings.append(Finding(
+            VERSION_MISMATCH, rel(init), version_line,
+            f"_ABI_VERSION = {py_version} but t1.cpp's "
+            f"t1_abi_version() returns {cpp_version}; bump them "
+            "together whenever an exported signature changes", ERROR,
+            f"_ABI_VERSION = {py_version}"))
+    for sym, line in sorted(symbols.items()):
+        if sym not in exports:
+            findings.append(Finding(
+                MISSING_EXPORT, rel(init), line,
+                f"ctypes binds lib.{sym} but t1.cpp's extern \"C\" "
+                "block does not define it", ERROR, f"lib.{sym}"))
+    for sym in sorted(exports - set(symbols)):
+        findings.append(Finding(
+            UNBOUND_EXPORT, rel(cpp), 1,
+            f"t1.cpp exports {sym}() but the ctypes loader never binds "
+            "it", WARNING, sym))
+    return findings

@@ -1,0 +1,215 @@
+"""graftlint engine: file discovery, rule dispatch, suppression, baseline.
+
+The engine parses every ``.py`` file under the target package once, hands
+the parsed project to each rule module, then filters the returned
+findings through inline suppressions (``# graftlint: disable=<rule>`` on
+the finding line or the line above, ``# graftlint: disable-file=<rule>``
+anywhere in the file) and the optional baseline file of known
+pre-existing findings.
+
+Rules live in :mod:`rules_jax` (device-region rules driven by a taint
+walk from ``jax.jit``/``shard_map`` roots), :mod:`rules_hygiene`
+(exception hygiene, empty packages) and :mod:`abi` (the native
+ctypes <-> C++ cross-checker).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .findings import ERROR, Finding
+
+_DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,\-]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([\w,\-]+)")
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file plus its import-alias environment."""
+    path: Path
+    relpath: str
+    tree: ast.Module
+    lines: list
+    np_aliases: set = field(default_factory=set)
+    jnp_aliases: set = field(default_factory=set)
+    jax_aliases: set = field(default_factory=set)
+    partial_aliases: set = field(default_factory=set)
+    jit_names: set = field(default_factory=set)      # from jax import jit
+    shardmap_names: set = field(default_factory=set)
+
+    def source_line(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+@dataclass
+class Project:
+    root: Path                       # package directory being linted
+    modules: list
+    # simple function name -> [(ModuleInfo, ast.FunctionDef)]
+    funcs_by_name: dict = field(default_factory=dict)
+
+    def module_for(self, relpath: str):
+        for mod in self.modules:
+            if mod.relpath == relpath:
+                return mod
+        return None
+
+
+def _collect_aliases(mod: ModuleInfo) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                if alias.name == "numpy":
+                    mod.np_aliases.add(name)
+                elif alias.name == "jax.numpy":
+                    mod.jnp_aliases.add(alias.asname or "jax")
+                elif alias.name in ("jax", "jax.lax", "jax.nn"):
+                    mod.jax_aliases.add(name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    if alias.name == "numpy":
+                        mod.jnp_aliases.add(name)
+                    elif alias.name == "jit":
+                        mod.jit_names.add(name)
+                    elif alias.name == "shard_map":
+                        mod.shardmap_names.add(name)
+                    elif alias.name in ("lax", "nn"):
+                        mod.jax_aliases.add(name)
+            elif node.module in ("jax.experimental.shard_map",
+                                 "jax.experimental"):
+                for alias in node.names:
+                    if alias.name == "shard_map":
+                        mod.shardmap_names.add(alias.asname or alias.name)
+            elif node.module == "functools":
+                for alias in node.names:
+                    if alias.name == "partial":
+                        mod.partial_aliases.add(alias.asname or alias.name)
+            elif node.module == "numpy":
+                # "from numpy import ..." is rare here; track the module
+                # itself only (per-symbol tracking is not needed yet).
+                pass
+
+
+def _index_functions(project: Project) -> None:
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                project.funcs_by_name.setdefault(node.name, []).append(
+                    (mod, node))
+
+
+def load_project(root: Path, rel_to: Path | None = None) -> Project:
+    """Parse every .py file under ``root`` into a Project."""
+    root = Path(root).resolve()
+    rel_to = (rel_to or root.parent).resolve()
+    modules = []
+    for path in sorted(root.rglob("*.py")):
+        try:
+            text = path.read_text(encoding="utf-8")
+            tree = ast.parse(text, filename=str(path))
+        except (OSError, SyntaxError) as exc:
+            mod = ModuleInfo(path, str(path.relative_to(rel_to)),
+                             ast.Module(body=[], type_ignores=[]), [])
+            modules.append(mod)
+            # A file the engine cannot parse is itself a finding; stash
+            # it on the module so run_lint can report it.
+            mod.parse_error = exc  # type: ignore[attr-defined]
+            continue
+        modules.append(ModuleInfo(path, str(path.relative_to(rel_to)),
+                                  tree, text.splitlines()))
+    project = Project(root, modules)
+    for mod in project.modules:
+        _collect_aliases(mod)
+    _index_functions(project)
+    return project
+
+
+def _suppressions(mod: ModuleInfo):
+    """(per-line {lineno: set(rules)}, file-wide set(rules))."""
+    per_line: dict = {}
+    file_wide: set = set()
+    for i, line in enumerate(mod.lines, start=1):
+        m = _DISABLE_RE.search(line)
+        if m:
+            per_line[i] = set(m.group(1).split(","))
+        m = _DISABLE_FILE_RE.search(line)
+        if m:
+            file_wide |= set(m.group(1).split(","))
+    return per_line, file_wide
+
+
+def _suppressed(finding: Finding, per_line: dict, file_wide: set) -> bool:
+    for rules in (file_wide, per_line.get(finding.line, ()),
+                  per_line.get(finding.line - 1, ())):
+        if rules and (finding.rule in rules or "all" in rules):
+            return True
+    return False
+
+
+def load_baseline(path: Path) -> set:
+    try:
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError):
+        return set()
+    return {f["fingerprint"] for f in data.get("findings", [])
+            if "fingerprint" in f}
+
+
+def write_baseline(path: Path, findings: list) -> None:
+    data = {"findings": [{"fingerprint": f.fingerprint(),
+                          "rule": f.rule, "path": f.path, "line": f.line}
+                         for f in findings]}
+    Path(path).write_text(json.dumps(data, indent=2) + "\n",
+                          encoding="utf-8")
+
+
+def run_lint(root: Path, baseline: set | None = None,
+             native_dir: Path | None = None) -> list:
+    """Lint the package at ``root``; returns surviving findings sorted by
+    (path, line). ``native_dir`` defaults to ``root``/native when present
+    (set it explicitly to cross-check an out-of-tree fixture)."""
+    from . import abi, rules_hygiene, rules_jax
+
+    project = load_project(Path(root))
+    findings: list = []
+    for mod in project.modules:
+        err = getattr(mod, "parse_error", None)
+        if err is not None:
+            findings.append(Finding("parse-error", mod.relpath,
+                                    getattr(err, "lineno", 1) or 1,
+                                    f"cannot parse: {err}", ERROR))
+    findings += rules_jax.run(project)
+    findings += rules_hygiene.run(project)
+    if native_dir is None:
+        candidate = Path(root) / "native"
+        native_dir = candidate if candidate.is_dir() else None
+    if native_dir is not None:
+        rel_root = Path(root).resolve().parent
+        findings += abi.check_native(Path(native_dir), rel_to=rel_root)
+
+    by_relpath = {mod.relpath: mod for mod in project.modules}
+    suppressions = {relpath: _suppressions(mod)
+                    for relpath, mod in by_relpath.items()}
+    kept = []
+    for f in findings:
+        mod = by_relpath.get(f.path)
+        if mod is not None:
+            per_line, file_wide = suppressions[f.path]
+            if _suppressed(f, per_line, file_wide):
+                continue
+            if not f.source_line:
+                f = Finding(f.rule, f.path, f.line, f.message, f.severity,
+                            mod.source_line(f.line))
+        if baseline and f.fingerprint() in baseline:
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept

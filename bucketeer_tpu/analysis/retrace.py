@@ -1,0 +1,72 @@
+"""Recompile sentinel: count XLA traces per pipeline stage.
+
+Every retrace of a jitted stage is a multi-second compile stall on TPU
+and usually a bug (an unstable shape or dtype leaking into a supposedly
+bucketed call path — exactly the regression class the front-end's
+power-of-two batch bucketing exists to prevent). The codec wraps the
+Python callable of each jitted program with :func:`instrument`; the
+wrapper body only executes when JAX traces it, so ``TRACE_COUNTS``
+counts compilations, not calls, with zero steady-state overhead.
+
+Tests assert stability with :func:`expect_max_retraces`::
+
+    with retrace.expect_max_retraces(0, stages=("transform",)):
+        encode_array(img)          # second encode of the same geometry
+
+Works on every JAX version (it relies on nothing but trace-time
+execution of the wrapped Python body).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import Counter
+
+TRACE_COUNTS: Counter = Counter()
+
+
+def instrument(stage: str, fn):
+    """Wrap ``fn`` so each JAX trace of it bumps ``TRACE_COUNTS[stage]``.
+
+    The returned wrapper is what gets jitted; its Python body runs once
+    per (re)compilation and never again, so the counter is exactly the
+    number of traced program variants.
+    """
+    def traced(*args, **kwargs):
+        TRACE_COUNTS[stage] += 1
+        return fn(*args, **kwargs)
+    traced.__name__ = getattr(fn, "__name__", stage)
+    return traced
+
+
+def snapshot() -> dict:
+    return dict(TRACE_COUNTS)
+
+
+def delta(before: dict, stages=None) -> dict:
+    """New traces per stage since ``before`` (only nonzero entries)."""
+    out = {}
+    for stage, count in TRACE_COUNTS.items():
+        if stages is not None and stage not in stages:
+            continue
+        d = count - before.get(stage, 0)
+        if d:
+            out[stage] = d
+    return out
+
+
+class RetraceError(AssertionError):
+    """More XLA recompilations than the test allowed."""
+
+
+@contextlib.contextmanager
+def expect_max_retraces(n: int, stages=None):
+    """Fail if the enclosed block triggers more than ``n`` new traces
+    (across ``stages``, or all instrumented stages when None)."""
+    before = snapshot()
+    yield
+    new = delta(before, stages)
+    total = sum(new.values())
+    if total > n:
+        raise RetraceError(
+            f"expected at most {n} XLA retrace(s), got {total}: {new} "
+            "— a shape or dtype is unstable on the jit path")

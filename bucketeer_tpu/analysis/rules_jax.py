@@ -1,0 +1,526 @@
+"""Device-region rules: host-sync, tracer-branch, float64-leak, d2h.
+
+The encoder's hot path is a handful of jit-compiled programs; a host
+sync or a Python branch on a tracer inside one of them either crashes at
+trace time (branch) or silently serializes the pipeline (sync). These
+rules find the *device region* — every function reachable from a
+``jax.jit``/``shard_map`` root — and run a lightweight taint walk over
+it: function parameters that receive traced arrays are tainted, taint
+propagates through arithmetic/indexing/jnp calls, and is laundered by
+static attributes (``.shape``, ``.dtype``, ...). Violations are:
+
+- ``host-sync``: ``np.*``, ``float()``/``int()``/``bool()``, ``.item()``,
+  ``.tolist()``, ``.block_until_ready()`` applied to a tainted value
+  inside the device region.
+- ``tracer-branch``: ``if``/``while``/``assert`` (or a conditional
+  expression) whose test is tainted — Python control flow cannot see a
+  tracer's value; use ``jnp.where``/``lax.cond``.
+- ``float64-leak``: any ``float64`` dtype reference inside the device
+  region (TPUs emulate f64 at a heavy cost; JAX silently downcasts
+  unless x64 is enabled, so either way the intent is wrong).
+- ``d2h-outside-gather``: ``jax.device_get`` in the codec/parallel
+  layers outside the sanctioned host-transfer functions — the design
+  allows exactly one compacted gather (frontend.fetch_payload) plus the
+  batch-entry wrappers; any other copy reintroduces the 4-byte/sample
+  transfer bottleneck the front-end exists to remove.
+
+Static arguments bound via ``functools.partial(fn, a, b, ...)`` at the
+jit root (and ``static_argnums``) are untainted, so plan/config objects
+do not false-positive Python branches on static configuration.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .findings import ERROR, Finding
+
+HOST_SYNC = "host-sync"
+TRACER_BRANCH = "tracer-branch"
+FLOAT64_LEAK = "float64-leak"
+D2H = "d2h-outside-gather"
+
+# Attribute reads that yield static (trace-time) values: using them does
+# not propagate taint.
+LAUNDER_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize",
+                 "weak_type", "sharding", "aval", "device"}
+# Builtins whose result is static even on a traced argument.
+LAUNDER_BUILTINS = {"isinstance", "len", "type", "hasattr", "callable",
+                    "id", "repr", "str", "format", "getattr"}
+# Builtins that force a concrete value out of a tracer.
+SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+SYNC_METHODS = {"item", "tolist", "block_until_ready",
+                "copy_to_host_async"}
+
+# Functions allowed to call jax.device_get in the codec/parallel layers:
+# the sanctioned compaction gather plus the host batch-entry wrappers.
+D2H_SANCTIONED = {"fetch_payload", "run_frontend", "run_tiles",
+                  "run_tiles_sharded"}
+D2H_SCOPES = ("codec", "parallel")
+
+
+@dataclass
+class _DeviceFn:
+    mod: object
+    node: ast.FunctionDef
+    tainted: set = field(default_factory=set)     # tainted param names
+
+
+def _param_names(node: ast.FunctionDef) -> list:
+    args = node.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names += [a.arg for a in args.kwonlyargs]
+    return names
+
+
+def _attr_root(node: ast.expr):
+    """Name at the base of an attribute chain, plus the chain attrs."""
+    attrs = []
+    while isinstance(node, ast.Attribute):
+        attrs.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, list(reversed(attrs))
+    return None, list(reversed(attrs))
+
+
+def _is_jnp_call(mod, func: ast.expr) -> bool:
+    root, chain = _attr_root(func)
+    if root is None:
+        return False
+    if root in mod.jnp_aliases:
+        return True
+    if root in mod.jax_aliases and chain[:1] != ["device_get"]:
+        return True
+    return False
+
+
+def _is_float64(mod, node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and node.value in ("float64", "f8",
+                                                        "double"):
+        return True
+    root, chain = _attr_root(node)
+    return (root in (mod.jnp_aliases | mod.np_aliases | mod.jax_aliases)
+            and chain[-1:] == ["float64"])
+
+
+class _FnAnalysis:
+    """One pass over a device function: propagate taint, collect call
+    edges (for device-region growth) and optionally emit findings."""
+
+    def __init__(self, mod, node, tainted_params, emit: bool):
+        self.mod = mod
+        self.node = node
+        self.env = set(tainted_params)
+        self.emit = emit
+        self.findings: list = []
+        # (callee name, [positional arg taints], {kwarg: taint})
+        self.edges: list = []
+        self.escapes: set = set()     # function names referenced as values
+
+    # -- reporting ----------------------------------------------------
+    def _finding(self, rule, node, message):
+        if self.emit:
+            self.findings.append(Finding(
+                rule, self.mod.relpath, node.lineno, message, ERROR,
+                self.mod.source_line(node.lineno)))
+
+    # -- expression taint ---------------------------------------------
+    def taint(self, node) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.env
+        if isinstance(node, ast.Attribute):
+            if node.attr in LAUNDER_ATTRS:
+                return False
+            return self.taint(node.value)
+        # NOTE: subexpressions are always evaluated eagerly (no `or`
+        # short-circuit) — taint() also records call edges and findings,
+        # so every subtree must be visited.
+        if isinstance(node, ast.Subscript):
+            parts = [self.taint(node.value), self.taint(node.slice)]
+            return any(parts)
+        if isinstance(node, ast.Slice):
+            parts = [self.taint(x) for x in
+                     (node.lower, node.upper, node.step)]
+            return any(parts)
+        if isinstance(node, ast.BinOp):
+            parts = [self.taint(node.left), self.taint(node.right)]
+            return any(parts)
+        if isinstance(node, ast.UnaryOp):
+            return self.taint(node.operand)
+        if isinstance(node, ast.BoolOp):
+            parts = [self.taint(v) for v in node.values]
+            return any(parts)
+        if isinstance(node, ast.Compare):
+            parts = [self.taint(node.left)]
+            parts += [self.taint(c) for c in node.comparators]
+            return any(parts)
+        if isinstance(node, ast.IfExp):
+            if self.taint(node.test):
+                self._finding(TRACER_BRANCH, node,
+                              "conditional expression on a traced value; "
+                              "use jnp.where / lax.select")
+            parts = [self.taint(node.body), self.taint(node.orelse)]
+            return any(parts)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            parts = [self.taint(e) for e in node.elts]
+            return any(parts)
+        if isinstance(node, ast.Dict):
+            parts = [self.taint(v) for v in node.values if v is not None]
+            return any(parts)
+        if isinstance(node, ast.Starred):
+            return self.taint(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            extra = set()
+            for comp in node.generators:
+                if self.taint(comp.iter):
+                    for n in ast.walk(comp.target):
+                        if isinstance(n, ast.Name):
+                            extra.add(n.id)
+            self.env |= extra
+            return self.taint(node.elt) or bool(extra)
+        if isinstance(node, ast.DictComp):
+            return self.taint(node.value)
+        if isinstance(node, ast.Call):
+            return self.call(node)
+        if isinstance(node, ast.JoinedStr):
+            return False
+        if isinstance(node, ast.Lambda):
+            return False
+        # Unknown node kind: conservative — treat as untainted rather
+        # than cascade false positives.
+        return False
+
+    # -- calls --------------------------------------------------------
+    def call(self, node: ast.Call) -> bool:
+        arg_taints = [self.taint(a) for a in node.args]
+        kw_taints = {kw.arg: self.taint(kw.value)
+                     for kw in node.keywords if kw.arg is not None}
+        any_tainted = any(arg_taints) or any(kw_taints.values())
+        func = node.func
+
+        # float64 leakage spelled as a string (attribute spellings like
+        # jnp.float64 are caught by the attribute walk in run()).
+        for kw in node.keywords:
+            if kw.arg == "dtype" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value in ("float64", "f8", "double"):
+                self._finding(FLOAT64_LEAK, node,
+                              "float64 dtype inside the device region")
+        if (isinstance(func, ast.Attribute) and func.attr == "astype"
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value in ("float64", "f8", "double")):
+            self._finding(FLOAT64_LEAK, node,
+                          "astype('float64') inside the device region")
+
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in LAUNDER_BUILTINS:
+                return False
+            if name in SYNC_BUILTINS and any_tainted:
+                self._finding(
+                    HOST_SYNC, node,
+                    f"{name}() on a traced value forces a host sync "
+                    "inside a jit-compiled function")
+                return False
+            if name in self.mod.partial_aliases and node.args:
+                inner, _ = _attr_root(node.args[0])
+                if inner:
+                    self.edges.append((inner, arg_taints[1:], kw_taints))
+                return any_tainted
+            self.edges.append((name, arg_taints, kw_taints))
+            return True if self._is_project_fn(name) else any_tainted
+
+        if isinstance(func, ast.Attribute):
+            root, chain = _attr_root(func)
+            # numpy call on a traced value: implicit device_get
+            if root in self.mod.np_aliases:
+                if any_tainted:
+                    self._finding(
+                        HOST_SYNC, node,
+                        f"np.{'.'.join(chain)} on a traced value pulls "
+                        "it to the host inside a jit-compiled function; "
+                        "use the jnp equivalent")
+                return False
+            if root in self.mod.jax_aliases and chain and \
+                    chain[-1] == "device_get":
+                self._finding(
+                    HOST_SYNC, node,
+                    "jax.device_get inside a jit-compiled function")
+                return False
+            if _is_jnp_call(self.mod, func):
+                return True
+            # method call: visit the receiver exactly once
+            obj_tainted = self.taint(func.value)
+            if func.attr in SYNC_METHODS and obj_tainted:
+                self._finding(
+                    HOST_SYNC, node,
+                    f".{func.attr}() on a traced value forces a host "
+                    "sync inside a jit-compiled function")
+                return False
+            return obj_tainted or any_tainted
+        return any_tainted
+
+    def _is_project_fn(self, name: str) -> bool:
+        return name in self.project_funcs if hasattr(
+            self, "project_funcs") else False
+
+    # -- statements ---------------------------------------------------
+    def _bind(self, target, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.env.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted)
+
+    def run(self) -> None:
+        # Two passes so taint assigned late in a loop body reaches
+        # earlier uses; findings are emitted only on the final pass.
+        emit = self.emit
+        self.emit = False
+        for stmt in self.node.body:
+            self.stmt(stmt)
+        self.emit = emit
+        self.findings = []
+        self.edges = []
+        for stmt in self.node.body:
+            self.stmt(stmt)
+
+    def stmt(self, node) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return            # nested defs analyzed via their own edges
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = node.value
+            tainted = self.taint(value) if value is not None else False
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            if isinstance(node, ast.AugAssign):
+                tainted = tainted or self.taint(node.target)
+            for t in targets:
+                self._bind(t, tainted)
+                # Track function-name escapes: `fwd = _fwd53_last` makes
+                # _fwd53_last part of the device region.
+            if isinstance(value, ast.Name):
+                self.escapes.add(value.id)
+            elif isinstance(value, ast.IfExp):
+                for side in (value.body, value.orelse):
+                    if isinstance(side, ast.Name):
+                        self.escapes.add(side.id)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            if self.taint(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                self._finding(
+                    TRACER_BRANCH, node,
+                    f"`{kind}` on a traced value (Python control flow "
+                    "cannot see tracer values; use jnp.where/lax.cond)")
+            for s in node.body + node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.Assert):
+            if self.taint(node.test):
+                self._finding(TRACER_BRANCH, node,
+                              "assert on a traced value")
+            return
+        if isinstance(node, ast.For):
+            self._bind(node.target, self.taint(node.iter))
+            for s in node.body + node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                self.taint(item.context_expr)
+            for s in node.body:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.Try):
+            for s in (node.body + node.orelse + node.finalbody
+                      + [h for hh in node.handlers for h in hh.body]):
+                self.stmt(s)
+            return
+        if isinstance(node, ast.Return):
+            if node.value is not None:
+                self.taint(node.value)
+            return
+        if isinstance(node, ast.Expr):
+            self.taint(node.value)
+            return
+        if isinstance(node, (ast.Raise, ast.Pass, ast.Break,
+                             ast.Continue, ast.Global, ast.Nonlocal,
+                             ast.Import, ast.ImportFrom, ast.Delete)):
+            return
+        # Fallback: walk child expressions for their side effects.
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.taint(child)
+
+
+def _unwrap_jit_target(mod, node):
+    """Resolve a jit/shard_map first argument to (func name, n_static).
+
+    Handles ``fn``, ``partial(fn, a, b)`` (leading args static) and the
+    retrace wrapper ``instrument("stage", fn_or_partial)``.
+    """
+    if isinstance(node, ast.Name):
+        return node.id, 0
+    if isinstance(node, ast.Call):
+        root, chain = _attr_root(node.func)
+        leaf = chain[-1] if chain else root
+        if leaf == "instrument" and node.args:
+            return _unwrap_jit_target(mod, node.args[-1])
+        if root in mod.partial_aliases or leaf == "partial":
+            if node.args and isinstance(node.args[0], ast.Name):
+                return node.args[0].id, len(node.args) - 1
+    return None, 0
+
+
+def _find_jit_roots(mod):
+    """[(target function name, set of static param positions)]."""
+    roots = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        root, chain = _attr_root(node.func)
+        leaf = chain[-1] if chain else root
+        is_jit = ((root in mod.jax_aliases and leaf in ("jit", "pmap"))
+                  or root in mod.jit_names
+                  or root in mod.shardmap_names
+                  or leaf == "shard_map" and root in mod.shardmap_names)
+        if not is_jit or not node.args:
+            continue
+        name, n_static = _unwrap_jit_target(mod, node.args[0])
+        if name is None:
+            continue
+        static = set(range(n_static))
+        for kw in node.keywords:
+            if kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and \
+                            isinstance(n.value, int):
+                        static.add(n.value)
+        roots.append((name, static))
+    return roots
+
+
+def _resolve(project, mod, name):
+    """Find the FunctionDef for a called name: same module first."""
+    candidates = project.funcs_by_name.get(name, [])
+    for cmod, cnode in candidates:
+        if cmod is mod:
+            return cmod, cnode
+    if len(candidates) == 1:
+        return candidates[0]
+    return None, None
+
+
+def _device_region(project):
+    """Fixpoint: map id(FunctionDef) -> _DeviceFn with tainted params."""
+    region: dict = {}
+    worklist: list = []
+
+    def add(mod, node, tainted) -> None:
+        key = id(node)
+        fn = region.get(key)
+        if fn is None:
+            fn = region[key] = _DeviceFn(mod, node)
+            fn.tainted |= set(tainted)
+            worklist.append(fn)
+            return
+        new = set(tainted) - fn.tainted
+        if new:
+            fn.tainted |= new
+            if fn not in worklist:
+                worklist.append(fn)
+
+    for mod in project.modules:
+        for name, static in _find_jit_roots(mod):
+            rmod, rnode = _resolve(project, mod, name)
+            if rnode is None:
+                continue
+            params = _param_names(rnode)
+            tainted = {p for i, p in enumerate(params) if i not in static}
+            add(rmod, rnode, tainted)
+
+    while worklist:
+        fn = worklist.pop()
+        analysis = _FnAnalysis(fn.mod, fn.node, fn.tainted, emit=False)
+        analysis.project_funcs = set(project.funcs_by_name)
+        analysis.run()
+        for name, arg_taints, kw_taints in analysis.edges:
+            cmod, cnode = _resolve(project, fn.mod, name)
+            if cnode is None or id(cnode) == id(fn.node):
+                continue
+            params = _param_names(cnode)
+            tainted = {params[i] for i, t in enumerate(arg_taints)
+                       if t and i < len(params)}
+            tainted |= {k for k, t in kw_taints.items()
+                        if t and k in params}
+            add(cmod, cnode, tainted)
+        for name in analysis.escapes:
+            cmod, cnode = _resolve(project, fn.mod, name)
+            if cnode is not None and id(cnode) != id(fn.node):
+                # A function referenced as a value from device code is
+                # device code; all params conservatively tainted.
+                add(cmod, cnode, set(_param_names(cnode)))
+    return region
+
+
+def _d2h_rule(project) -> list:
+    findings = []
+    for mod in project.modules:
+        parts = mod.relpath.split("/")
+        if not any(p in parts for p in D2H_SCOPES):
+            continue
+        for fnode in ast.walk(mod.tree):
+            if not isinstance(fnode, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            if fnode.name in D2H_SANCTIONED:
+                continue
+            for node in ast.walk(fnode):
+                if not isinstance(node, ast.Call):
+                    continue
+                root, chain = _attr_root(node.func)
+                if root in mod.jax_aliases and chain[-1:] == \
+                        ["device_get"]:
+                    findings.append(Finding(
+                        D2H, mod.relpath, node.lineno,
+                        f"jax.device_get in {fnode.name}(): "
+                        "device-to-host copies in the codec/parallel "
+                        "layers are restricted to the sanctioned "
+                        f"transfer functions {sorted(D2H_SANCTIONED)}",
+                        ERROR, mod.source_line(node.lineno)))
+    return findings
+
+
+def run(project) -> list:
+    findings: list = []
+    region = _device_region(project)
+    seen = set()
+    for fn in region.values():
+        key = id(fn.node)
+        if key in seen:
+            continue
+        seen.add(key)
+        analysis = _FnAnalysis(fn.mod, fn.node, fn.tainted, emit=True)
+        analysis.project_funcs = set(project.funcs_by_name)
+        analysis.run()
+        findings += analysis.findings
+        # float64 attribute references (jnp.float64 / np.float64) in
+        # device code, in any position (astype arg, dtype=, bare).
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Attribute) and node.attr == \
+                    "float64" and _is_float64(fn.mod, node):
+                findings.append(Finding(
+                    FLOAT64_LEAK, fn.mod.relpath, node.lineno,
+                    "float64 reference inside the device region",
+                    ERROR, fn.mod.source_line(node.lineno)))
+    findings += _d2h_rule(project)
+    return findings

@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..analysis.contracts import contract
 from . import codestream as cs
 from . import frontend
 from . import jp2 as jp2box
@@ -548,6 +549,8 @@ def _legacy_tier1(groups: dict, plans: dict, img: np.ndarray,
     return tile_records, blocks, weights, qcd_values
 
 
+@contract(shapes={"img": [("H", "W"), ("H", "W", "C")]},
+          dtypes={"img": "number"})
 def encode_array(img: np.ndarray, bitdepth: int = 8,
                  params: EncodeParams | None = None) -> bytes:
     """Encode a (H, W) or (H, W, 3) array into a raw JPEG 2000 codestream."""
@@ -812,6 +815,8 @@ def _qcd_values(plan: TilePlan) -> list:
     return vals
 
 
+@contract(shapes={"img": [("H", "W"), ("H", "W", "C")]},
+          dtypes={"img": "number"})
 def encode_jp2(img: np.ndarray, bitdepth: int = 8,
                params: EncodeParams | None = None, jpx: bool = False) -> bytes:
     """Encode to a boxed .jp2 / .jpx file image."""

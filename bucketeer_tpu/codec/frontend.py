@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import retrace
+from ..analysis.contracts import contract
 from .pipeline import TilePlan, _bucket, _step_map, _transform_batch
 from .quant import FRAC_BITS
 
@@ -160,7 +162,9 @@ def _frontend_body(plan: TilePlan, P: int, frac_bits: int,
 def _compiled_frontend(plan: TilePlan, P: int):
     frac_bits = 0 if plan.lossless else FRAC_BITS
     step_map = jnp.asarray(_step_map(plan)) if not plan.lossless else None
-    return jax.jit(partial(_frontend_body, plan, P, frac_bits, step_map))
+    return jax.jit(retrace.instrument(
+        "frontend", partial(_frontend_body, plan, P, frac_bits,
+                            step_map)))
 
 
 @dataclass
@@ -180,6 +184,8 @@ class FrontendResult:
         return self.n_tiles * self.layout.n_per_tile
 
 
+@contract(shapes={"tiles": [("B", "h", "w"), ("B", "h", "w", "C")]},
+          dtypes={"tiles": "number"})
 def run_frontend(plan: TilePlan, tiles: np.ndarray) -> FrontendResult:
     """Transform + blockify + stats for a (B, h, w[, C]) tile batch.
 
@@ -207,7 +213,7 @@ def run_frontend(plan: TilePlan, tiles: np.ndarray) -> FrontendResult:
 def _compiled_gather(chunk_rows: int):
     def gather(rows, src):
         return rows[src]
-    return jax.jit(gather)
+    return jax.jit(retrace.instrument("gather", gather))
 
 
 GATHER_CHUNK = 4096      # rows per gather dispatch (= 2 MB of payload)
@@ -234,6 +240,7 @@ def payload_plan(nbps: np.ndarray, floors: np.ndarray, P: int):
     return src, offsets
 
 
+@contract(shapes={"src": ("R",)}, dtypes={"src": "integer"})
 def fetch_payload(result: FrontendResult, src: np.ndarray) -> np.ndarray:
     """Compact the selected rows on device and copy them host-side in
     fixed-size gather chunks (one compiled program, bounded padding).

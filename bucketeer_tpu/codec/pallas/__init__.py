@@ -1,0 +1,17 @@
+"""Reserved for hand-written Pallas TPU kernels.
+
+Planned role: fuse the Tier-1 front-end's bit-plane packing and
+significance statistics (codec/frontend.py) into a single custom kernel
+once the plain-jnp formulation stops scaling — the packing step's
+``(N, 64, 8, 8) -> (N, 512)`` byte assembly is the likeliest candidate
+for a Pallas rewrite because XLA materializes an intermediate the kernel
+could keep in registers.
+
+Nothing here is implemented yet. The front-end runs entirely as jitted
+jnp today; an earlier docstring claimed otherwise and was reverted
+(commit b4c697b), which is why the empty-package lint rule
+(``graftlint: empty-package``) now requires this stub to say so
+explicitly. When adding the first kernel, read the TPU guide under
+/opt/skills/guides/ first and keep the jnp path as the fallback for
+CPU-backend tests.
+"""

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import retrace
+from ..analysis.contracts import contract
 from .dwt import dwt2d_forward, synthesis_gains
 from .quant import (FRAC_BITS, SubbandQuant, quantize_fp,
                     signal_irreversible, signal_reversible,
@@ -173,7 +175,8 @@ def compiled_transform(plan: TilePlan):
     on the batch size; callers bound retraces by padding B to a bucket
     size (:func:`run_tiles`)."""
     step_map = jnp.asarray(_step_map(plan)) if not plan.lossless else None
-    return jax.jit(partial(_transform_batch, plan, step_map))
+    return jax.jit(retrace.instrument(
+        "transform", partial(_transform_batch, plan, step_map)))
 
 
 def _bucket(b: int) -> int:
@@ -186,6 +189,8 @@ def _bucket(b: int) -> int:
     return n
 
 
+@contract(shapes={"tiles": [("B", "h", "w"), ("B", "h", "w", "C")]},
+          dtypes={"tiles": "number"})
 def run_tiles(plan: TilePlan, tiles: np.ndarray) -> np.ndarray:
     """Encode-transform a (B, h, w[, C]) batch of tiles; returns
     (B, C, h, w) int32 on host."""

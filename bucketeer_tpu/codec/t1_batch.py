@@ -12,6 +12,7 @@ import os
 import numpy as np
 
 from .. import native
+from ..analysis.contracts import contract
 from . import t1
 
 _BAND_CLS = {"LL": 0, "LH": 0, "HH": 1, "HL": 2}
@@ -53,6 +54,12 @@ def _collect(lib, handle, n: int) -> list:
         lib.t1_result_free(handle)
 
 
+@contract(shapes={"payload": ("R", 512), "offsets": ("n1",),
+                  "nbps": ("n",), "floors": ("n",), "hs": ("n",),
+                  "ws": ("n",)},
+          dtypes={"payload": "uint8", "offsets": "integer",
+                  "nbps": "integer", "floors": "integer",
+                  "hs": "integer", "ws": "integer"})
 def encode_packed(payload: np.ndarray, offsets: np.ndarray,
                   nbps: np.ndarray, floors: np.ndarray,
                   hs: np.ndarray, ws: np.ndarray,

@@ -157,6 +157,8 @@ class MessageBus:
             for task in con.tasks:
                 try:
                     await task
-                except (asyncio.CancelledError, Exception):
-                    pass
+                except asyncio.CancelledError:
+                    pass          # the cancellation we just requested
+                except Exception:
+                    LOG.exception("consumer task died during bus close")
         self._consumers.clear()

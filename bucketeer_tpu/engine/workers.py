@@ -256,7 +256,9 @@ class FinalizeJobWorker:
                             f"'{job.name}': {exc}",
                     })
                 except Exception:
-                    pass
+                    LOG.exception(
+                        "slack error-channel fallback also failed for "
+                        "job %r (channel %s)", job.name, error_channel)
 
 
 class LargeImageWorker:

@@ -14,9 +14,12 @@ Set ``BUCKETEER_NO_NATIVE=1`` to force the Python path.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 from pathlib import Path
+
+LOG = logging.getLogger(__name__)
 
 _DIR = Path(__file__).resolve().parent
 _LIB_PATH = _DIR / "libbucketeer_t1.so"
@@ -25,12 +28,42 @@ _lib = None
 _tried = False
 
 
-def _build() -> bool:
+class NativeABIError(RuntimeError):
+    """The loaded libbucketeer_t1.so speaks a different ABI than these
+    bindings expect. Calling into it anyway would misread the argument
+    layout, so the loader refuses it."""
+
+    def __init__(self, found: int, expected: int, lib_path: Path):
+        self.found = found
+        self.expected = expected
+        self.lib_path = Path(lib_path)
+        super().__init__(
+            f"{self.lib_path.name}: t1_abi_version() returned {found}, "
+            f"these bindings expect {expected} "
+            "(the symbol is absent entirely when -1). Remediation: "
+            f"delete {self.lib_path} so it is rebuilt from t1.cpp, or "
+            "set BUCKETEER_NO_NATIVE=1 to force the pure-Python coder.")
+
+
+def _check_abi(lib: ctypes.CDLL) -> None:
+    """Raise :class:`NativeABIError` unless ``lib`` matches
+    ``_ABI_VERSION`` (the single ABI guard; every load path funnels
+    through here)."""
+    try:
+        lib.t1_abi_version.restype = ctypes.c_int32
+        found = int(lib.t1_abi_version())
+    except AttributeError:
+        found = -1
+    if found != _ABI_VERSION:
+        raise NativeABIError(found, _ABI_VERSION, _LIB_PATH)
+
+
+def _build(out: Path | None = None) -> bool:
     src = _DIR / "t1.cpp"
     try:
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-             "-o", str(_LIB_PATH), str(src)],
+             "-o", str(out or _LIB_PATH), str(src)],
             check=True, capture_output=True, timeout=300)
         return True
     except (OSError, subprocess.SubprocessError):
@@ -62,20 +95,30 @@ def load():
     # called with a newer argument layout. Rebuild if possible, else
     # fall back to the pure-Python coder.
     try:
-        lib.t1_abi_version.restype = ctypes.c_int32
-        abi = int(lib.t1_abi_version())
-    except AttributeError:
-        abi = -1
-    if abi != _ABI_VERSION:
-        if not (src.exists() and _build()):
+        _check_abi(lib)
+    except NativeABIError as exc:
+        # dlopen dedupes by pathname, so rebuilding in place and
+        # re-CDLL'ing _LIB_PATH would hand back the stale mapping (and
+        # g++ truncating a currently-mapped .so risks SIGBUS). Build to
+        # a distinct path, load that, then rename it over _LIB_PATH
+        # (atomic, new inode) so future processes load it directly.
+        rebuilt = _LIB_PATH.with_suffix(f".v{_ABI_VERSION}.so")
+        if not (src.exists() and _build(rebuilt)):
+            LOG.warning("%s; no source to rebuild from — falling back "
+                        "to the pure-Python Tier-1 coder", exc)
             return None
         try:
-            lib = ctypes.CDLL(str(_LIB_PATH))
-            lib.t1_abi_version.restype = ctypes.c_int32
-            if int(lib.t1_abi_version()) != _ABI_VERSION:
-                return None
-        except (OSError, AttributeError):
+            lib = ctypes.CDLL(str(rebuilt))
+            _check_abi(lib)
+        except (OSError, NativeABIError) as exc2:
+            LOG.warning("%s after rebuild — falling back to the "
+                        "pure-Python Tier-1 coder", exc2)
             return None
+        try:
+            os.replace(rebuilt, _LIB_PATH)
+        except OSError:
+            LOG.warning("could not move rebuilt %s over %s; the stale "
+                        "library remains on disk", rebuilt, _LIB_PATH)
     lib.t1_encode_blocks.restype = ctypes.c_void_p
     lib.t1_encode_blocks.argtypes = [
         ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,

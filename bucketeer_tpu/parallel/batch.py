@@ -13,10 +13,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from ..analysis.contracts import contract
 from ..codec.pipeline import TilePlan, compiled_transform
 from .mesh import DATA_AXIS, batch_sharding
 
 
+@contract(shapes={"tiles": [("B", "h", "w"), ("B", "h", "w", "C")]},
+          dtypes={"tiles": "number"})
 def run_tiles_sharded(plan: TilePlan, tiles: np.ndarray,
                       mesh: Mesh) -> np.ndarray:
     """Like :func:`bucketeer_tpu.codec.pipeline.run_tiles` but with the

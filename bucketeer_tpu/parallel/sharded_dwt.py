@@ -21,17 +21,28 @@ subband row ordering — is shard-local with no resharding between levels.
 """
 from __future__ import annotations
 
+import inspect
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
+try:                              # jax >= 0.8 exports it at top level
+    from jax import shard_map
+except ImportError:               # older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..analysis.contracts import contract
 from ..codec.dwt import (ALPHA, BETA, DELTA, GAMMA, K_HI, K_LO,
                          _fwd53_last, _fwd97_last)
 from .mesh import TILE_AXIS
+
+# The replication-check kwarg was renamed check_rep -> check_vma.
+_SM_NO_CHECK = ({"check_vma": False}
+                if "check_vma" in inspect.signature(shard_map).parameters
+                else {"check_rep": False})
 
 HALO = 4  # covers the 4-step 9/7 lifting support
 
@@ -100,6 +111,8 @@ def _local_dwt(levels: int, reversible: bool, axis_name: str,
     return ll, bands
 
 
+@contract(shapes={"x": [("H", "W"), ("C", "H", "W")]},
+          dtypes={"x": "number"})
 def sharded_dwt2d_forward(x: jnp.ndarray, levels: int, reversible: bool,
                           mesh: Mesh):
     """Multi-level forward DWT of one giant tile, rows sharded over the
@@ -113,5 +126,5 @@ def sharded_dwt2d_forward(x: jnp.ndarray, levels: int, reversible: bool,
     spec = P(*row)
     fn = shard_map(partial(_local_dwt, levels, reversible, TILE_AXIS),
                    mesh=mesh, in_specs=(spec,), out_specs=spec,
-                   check_vma=False)
+                   **_SM_NO_CHECK)
     return fn(x)

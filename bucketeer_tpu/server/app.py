@@ -183,7 +183,13 @@ class Api:
                 await start_job(job, self.engine.bus, self.engine.config,
                                 self.engine.flags)
         except Exception:
-            LOG.exception("start_job failed for %s", job.name)
+            # The client already got its 200 (the success page is sent
+            # before dispatch), so this log line is the only trace of a
+            # dispatch failure — carry the full request context.
+            LOG.exception(
+                "start_job failed for job %r (%d items, %d remaining, "
+                "slack handle %r)", job.name, len(job.items),
+                job.remaining(), job.slack_handle)
 
     # --- updateBatchJob (reference: handlers/BatchJobStatusHandler.java:56-197) ---
     async def update_batch_job(self, request: web.Request) -> web.Response:

@@ -1,0 +1,343 @@
+"""graftlint engine + rules: seeded-defect fixtures, suppression,
+baseline, ABI cross-check, CLI exit codes.
+
+Each seeded-defect test plants exactly one violation in a scratch
+package and asserts the analyzer reports exactly one finding of the
+expected rule — the acceptance bar for the analyzer's signal/noise.
+"""
+import json
+import textwrap
+
+import pytest
+
+from bucketeer_tpu.analysis import abi, lint
+from bucketeer_tpu.analysis.__main__ import main as cli_main
+
+
+def _make_pkg(tmp_path, files: dict):
+    """Write a scratch package and return its root directory."""
+    root = tmp_path / "pkg"
+    for relpath, body in files.items():
+        path = root / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(body), encoding="utf-8")
+        init = path.parent / "__init__.py"
+        if path.name != "__init__.py" and not init.exists():
+            init.write_text('"""fixture"""\n', encoding="utf-8")
+    if not (root / "__init__.py").exists():
+        (root / "__init__.py").write_text('"""fixture"""\n',
+                                          encoding="utf-8")
+    return root
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# --- seeded defects: exactly one finding each -------------------------
+
+def test_seeded_tracer_host_sync(tmp_path):
+    root = _make_pkg(tmp_path, {"codec/bad.py": """\
+        import jax
+        import jax.numpy as jnp
+
+
+        def _body(x):
+            y = jnp.abs(x)
+            return y.item()
+
+        _fn = jax.jit(_body)
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["host-sync"]
+    assert findings[0].line == 7
+
+
+def test_seeded_abi_mismatch(tmp_path):
+    native = tmp_path / "native"
+    native.mkdir()
+    (native / "__init__.py").write_text(textwrap.dedent("""\
+        import ctypes
+        _ABI_VERSION = 4
+
+
+        def load(lib):
+            lib.t1_abi_version.restype = ctypes.c_int32
+            lib.t1_encode_packed.restype = ctypes.c_void_p
+        """), encoding="utf-8")
+    (native / "t1.cpp").write_text(textwrap.dedent("""\
+        #include <cstdint>
+        extern "C" {
+        int32_t t1_abi_version() { return 3; }
+        void t1_encode_packed(int n) {}
+        }
+        """), encoding="utf-8")
+    findings = abi.check_native(native)
+    assert _rules(findings) == ["abi-version-mismatch"]
+    assert "4" in findings[0].message and "3" in findings[0].message
+
+
+def test_seeded_swallowed_exception(tmp_path):
+    root = _make_pkg(tmp_path, {"engine/bad.py": """\
+        def f(g):
+            try:
+                return g()
+            except Exception:
+                pass
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["swallowed-exception"]
+
+
+# --- the other device-region rules ------------------------------------
+
+def test_tracer_branch_and_float64(tmp_path):
+    root = _make_pkg(tmp_path, {"codec/bad.py": """\
+        import jax
+        import jax.numpy as jnp
+
+
+        def _body(x):
+            if x.sum() > 0:
+                x = x * 2
+            return x.astype(jnp.float64)
+
+        _fn = jax.jit(_body)
+        """})
+    findings = lint.run_lint(root)
+    assert sorted(_rules(findings)) == ["float64-leak", "tracer-branch"]
+
+
+def test_partial_static_args_not_tainted(tmp_path):
+    """Config objects bound via partial at the jit root may drive Python
+    branches — only the traced operands are tainted."""
+    root = _make_pkg(tmp_path, {"codec/ok.py": """\
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+
+        def _body(plan, x):
+            if plan.lossless:                  # static: fine
+                x = x + 1
+            if x.shape[0] == 1:                # shape: static, fine
+                x = x * 2
+            return jnp.abs(x)
+
+        _fn = jax.jit(partial(_body, object()))
+        """})
+    assert lint.run_lint(root) == []
+
+
+def test_d2h_outside_gather(tmp_path):
+    root = _make_pkg(tmp_path, {"codec/xfer.py": """\
+        import jax
+
+
+        def helper(arr):
+            return jax.device_get(arr)
+
+
+        def fetch_payload(arr):
+            return jax.device_get(arr)         # sanctioned
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["d2h-outside-gather"]
+    assert "helper" in findings[0].message
+
+
+def test_broad_handler_that_logs_is_clean(tmp_path):
+    root = _make_pkg(tmp_path, {"engine/ok.py": """\
+        import logging
+
+        LOG = logging.getLogger(__name__)
+
+
+        def f(g):
+            try:
+                return g()
+            except Exception:
+                LOG.exception("g failed")
+            try:
+                return g()
+            except Exception as exc:
+                return ("error", str(exc))
+        """})
+    assert lint.run_lint(root) == []
+
+
+def test_empty_package_rule(tmp_path):
+    root = _make_pkg(tmp_path, {"sub/__init__.py": ""})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["empty-package"]
+    # A docstring satisfies the rule.
+    (root / "sub" / "__init__.py").write_text('"""planned."""\n',
+                                              encoding="utf-8")
+    assert lint.run_lint(root) == []
+
+
+# --- suppression + baseline -------------------------------------------
+
+def test_inline_suppression(tmp_path):
+    root = _make_pkg(tmp_path, {"engine/sup.py": """\
+        def f(g):
+            try:
+                return g()
+            except Exception:   # graftlint: disable=swallowed-exception
+                pass
+        """})
+    assert lint.run_lint(root) == []
+
+
+def test_file_level_suppression(tmp_path):
+    root = _make_pkg(tmp_path, {"engine/sup.py": """\
+        # graftlint: disable-file=swallowed-exception
+        def f(g):
+            try:
+                return g()
+            except Exception:
+                pass
+        """})
+    assert lint.run_lint(root) == []
+
+
+def test_baseline_filters_known_findings(tmp_path):
+    root = _make_pkg(tmp_path, {"engine/bad.py": """\
+        def f(g):
+            try:
+                return g()
+            except Exception:
+                pass
+        """})
+    findings = lint.run_lint(root)
+    assert len(findings) == 1
+    baseline_path = tmp_path / "baseline.json"
+    lint.write_baseline(baseline_path, findings)
+    baseline = lint.load_baseline(baseline_path)
+    assert lint.run_lint(root, baseline=baseline) == []
+    # The fingerprint keys on content, not line number: shifting the
+    # function down the file keeps the suppression.
+    path = root / "engine" / "bad.py"
+    path.write_text("X = 1\n\n\n" + path.read_text(encoding="utf-8"),
+                    encoding="utf-8")
+    assert lint.run_lint(root, baseline=baseline) == []
+
+
+# --- ABI cross-checker corners ----------------------------------------
+
+def test_abi_missing_export(tmp_path):
+    native = tmp_path / "native"
+    native.mkdir()
+    (native / "__init__.py").write_text(
+        "import ctypes\n_ABI_VERSION = 3\n\n\n"
+        "def load(lib):\n"
+        "    lib.t1_abi_version.restype = ctypes.c_int32\n"
+        "    lib.t1_gone.restype = ctypes.c_void_p\n",
+        encoding="utf-8")
+    (native / "t1.cpp").write_text(
+        '#include <cstdint>\nextern "C" {\n'
+        "int32_t t1_abi_version() { return 3; }\n}\n", encoding="utf-8")
+    findings = abi.check_native(native)
+    assert _rules(findings) == ["abi-missing-export"]
+    assert "t1_gone" in findings[0].message
+
+
+def test_abi_unbound_export_is_warning(tmp_path):
+    native = tmp_path / "native"
+    native.mkdir()
+    (native / "__init__.py").write_text(
+        "import ctypes\n_ABI_VERSION = 3\n\n\n"
+        "def load(lib):\n"
+        "    lib.t1_abi_version.restype = ctypes.c_int32\n",
+        encoding="utf-8")
+    (native / "t1.cpp").write_text(
+        '#include <cstdint>\nextern "C" {\n'
+        "int32_t t1_abi_version() { return 3; }\n"
+        "void t1_extra(int n) {}\n}\n", encoding="utf-8")
+    findings = abi.check_native(native)
+    assert _rules(findings) == ["abi-unbound-export"]
+    assert findings[0].severity == "warning"
+
+
+def test_abi_real_native_package_is_in_sync():
+    from pathlib import Path
+    native = Path(__file__).resolve().parent.parent / "bucketeer_tpu" \
+        / "native"
+    assert [f for f in abi.check_native(native)
+            if f.severity == "error"] == []
+
+
+# --- the runtime ABI guard (native/__init__.py) ------------------------
+
+class _FakeSymbol:
+    def __init__(self, version):
+        self._version = version
+        self.restype = None
+
+    def __call__(self):
+        return self._version
+
+
+class _FakeLib:
+    def __init__(self, version):
+        self.t1_abi_version = _FakeSymbol(version)
+
+
+def test_native_abi_guard_raises_typed_error():
+    from bucketeer_tpu import native
+
+    native._check_abi(_FakeLib(native._ABI_VERSION))   # in sync: ok
+    with pytest.raises(native.NativeABIError) as exc:
+        native._check_abi(_FakeLib(native._ABI_VERSION + 1))
+    assert exc.value.expected == native._ABI_VERSION
+    assert exc.value.found == native._ABI_VERSION + 1
+    assert "BUCKETEER_NO_NATIVE" in str(exc.value)     # remediation hint
+
+    with pytest.raises(native.NativeABIError) as exc:
+        native._check_abi(object())                    # symbol missing
+    assert exc.value.found == -1
+
+
+# --- CLI ---------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    root = _make_pkg(tmp_path, {"engine/bad.py": """\
+        def f(g):
+            try:
+                return g()
+            except Exception:
+                pass
+        """})
+    assert cli_main([str(root), "--strict"]) == 1
+    out = capsys.readouterr().out
+    assert "swallowed-exception" in out
+
+    # --write-baseline makes the gate start green...
+    assert cli_main([str(root), "--write-baseline",
+                     "--baseline", str(tmp_path / "b.json")]) == 0
+    assert cli_main([str(root), "--strict",
+                     "--baseline", str(tmp_path / "b.json")]) == 0
+    capsys.readouterr()
+
+    # ...and --json stays machine-readable.
+    assert cli_main([str(root), "--json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data[0]["rule"] == "swallowed-exception"
+    assert cli_main(["/nonexistent-dir"]) == 2
+
+
+def test_cli_warnings_fail_only_in_strict(tmp_path):
+    native_pkg = _make_pkg(tmp_path, {"native/__init__.py": """\
+        import ctypes
+        _ABI_VERSION = 3
+
+
+        def load(lib):
+            lib.t1_abi_version.restype = ctypes.c_int32
+        """})
+    (native_pkg / "native" / "t1.cpp").write_text(
+        '#include <cstdint>\nextern "C" {\n'
+        "int32_t t1_abi_version() { return 3; }\n"
+        "void t1_extra(int n) {}\n}\n", encoding="utf-8")
+    assert cli_main([str(native_pkg)]) == 0          # warning only
+    assert cli_main([str(native_pkg), "--strict"]) == 1

@@ -1,0 +1,102 @@
+"""@contract runtime shape/dtype checks (analysis/contracts.py)."""
+import numpy as np
+import pytest
+
+from bucketeer_tpu.analysis.contracts import (ContractViolation, contract,
+                                              contracts_enabled)
+
+
+def test_enabled_under_pytest():
+    # pytest is in sys.modules here, so contracts default to on.
+    assert contracts_enabled()
+
+
+def test_shape_and_symbol_consistency():
+    @contract(shapes={"a": ("n", "m"), "b": ("m",)})
+    def f(a, b):
+        return a @ b
+
+    f(np.zeros((3, 4)), np.zeros(4))
+    with pytest.raises(ContractViolation, match="'b'"):
+        f(np.zeros((3, 4)), np.zeros(5))      # m mismatch across args
+
+
+def test_rank_alternatives_and_exact_dims():
+    @contract(shapes={"x": [("B", "h", "w"), ("B", "h", "w", 3)]})
+    def f(x):
+        return x
+
+    f(np.zeros((2, 8, 8)))
+    f(np.zeros((2, 8, 8, 3)))
+    with pytest.raises(ContractViolation):
+        f(np.zeros((2, 8, 8, 4)))             # C must be exactly 3
+    with pytest.raises(ContractViolation):
+        f(np.zeros(8))                        # no rank-1 alternative
+
+
+def test_wildcard_and_non_array():
+    @contract(shapes={"x": (None, 512)})
+    def f(x):
+        return x
+
+    f(np.zeros((7, 512), dtype=np.uint8))
+    with pytest.raises(ContractViolation, match="array-like"):
+        f([1, 2, 3])
+
+
+def test_dtype_kinds_and_exact():
+    @contract(dtypes={"x": "integer", "y": ("float32", "float64"),
+                      "z": "uint8"})
+    def f(x, y, z):
+        return x, y, z
+
+    f(np.zeros(3, np.int64), np.zeros(3, np.float32),
+      np.zeros(3, np.uint8))
+    with pytest.raises(ContractViolation, match="'x'"):
+        f(np.zeros(3, np.float32), np.zeros(3, np.float32),
+          np.zeros(3, np.uint8))
+    with pytest.raises(ContractViolation, match="'z'"):
+        f(np.zeros(3, np.int64), np.zeros(3, np.float64),
+          np.zeros(3, np.int8))
+
+
+def test_checks_jax_arrays_too():
+    import jax.numpy as jnp
+
+    @contract(shapes={"x": ("n",)}, dtypes={"x": "floating"})
+    def f(x):
+        return x
+
+    f(jnp.zeros(4, jnp.float32))
+    with pytest.raises(ContractViolation):
+        f(jnp.zeros((4, 4), jnp.float32))
+
+
+def test_env_var_disables(monkeypatch):
+    monkeypatch.setenv("BUCKETEER_CONTRACTS", "0")
+
+    def g(x):
+        return x
+
+    decorated = contract(shapes={"x": ("n",)})(g)
+    assert decorated is g          # no-op at decoration time
+    monkeypatch.setenv("BUCKETEER_CONTRACTS", "1")
+    decorated = contract(shapes={"x": ("n",)})(g)
+    assert decorated is not g
+
+
+def test_codec_entry_points_are_contracted():
+    from bucketeer_tpu.codec import encoder, frontend, pipeline, t1_batch
+    from bucketeer_tpu.parallel import batch, sharded_dwt
+
+    for fn in (pipeline.run_tiles, frontend.run_frontend,
+               frontend.fetch_payload, encoder.encode_array,
+               encoder.encode_jp2, t1_batch.encode_packed,
+               batch.run_tiles_sharded,
+               sharded_dwt.sharded_dwt2d_forward):
+        assert hasattr(fn, "__contract__"), fn
+
+    with pytest.raises(ContractViolation):
+        pipeline.run_tiles(None, np.zeros(16))        # rank 1: rejected
+    with pytest.raises(ContractViolation):
+        encoder.encode_array(np.zeros((4, 4), dtype=object))

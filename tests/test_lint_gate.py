@@ -1,0 +1,39 @@
+"""The lint gate: the repo itself must pass graftlint in strict mode.
+
+This is the pytest-collected form of the CI job — a rule regression or a
+new violation anywhere in bucketeer_tpu fails the suite, not just the
+lint workflow.
+"""
+from pathlib import Path
+
+from bucketeer_tpu.analysis import lint
+from bucketeer_tpu.analysis.__main__ import DEFAULT_BASELINE
+from bucketeer_tpu.analysis.__main__ import main as cli_main
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "bucketeer_tpu"
+
+
+def test_repo_is_lint_clean_strict():
+    baseline = lint.load_baseline(REPO / DEFAULT_BASELINE)
+    findings = lint.run_lint(PKG, baseline=baseline)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_cli_strict_exits_zero():
+    assert cli_main([str(PKG), "--strict",
+                     "--baseline", str(REPO / DEFAULT_BASELINE)]) == 0
+
+
+def test_device_region_is_discovered():
+    """Guard against the analyzer silently losing the jit roots (an
+    empty device region would make the jax rules vacuous)."""
+    from bucketeer_tpu.analysis import rules_jax
+
+    project = lint.load_project(PKG)
+    region = rules_jax._device_region(project)
+    names = {fn.node.name for fn in region.values()}
+    # The three pipeline stages and the cross-module lifting kernels.
+    assert {"_transform_batch", "_frontend_body", "gather",
+            "dwt2d_forward", "_local_dwt", "rct_forward",
+            "quantize_fp"} <= names

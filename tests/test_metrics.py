@@ -1,0 +1,75 @@
+"""server/metrics.py: StageStats.record, the Metrics.time context
+manager, and the MPixels/s report."""
+import pytest
+
+from bucketeer_tpu.server.metrics import Metrics, StageStats
+
+
+def test_stage_stats_record_accumulates():
+    st = StageStats()
+    st.record(0.5, pixels=100)
+    st.record(1.5, pixels=200)
+    st.record(0.25)
+    assert st.count == 3
+    assert st.total_s == pytest.approx(2.25)
+    assert st.max_s == pytest.approx(1.5)
+    assert st.pixels == 300
+
+
+def test_time_context_manager_records():
+    m = Metrics()
+    with m.time("stage_a", pixels=1_000_000):
+        pass
+    st = m.stages["stage_a"]
+    assert st.count == 1
+    assert st.total_s >= 0.0
+    assert st.pixels == 1_000_000
+
+
+def test_time_records_even_on_exception():
+    m = Metrics()
+    with pytest.raises(ValueError):
+        with m.time("boom"):
+            raise ValueError("x")
+    assert m.stages["boom"].count == 1
+
+
+def test_record_passthrough():
+    m = Metrics()
+    m.record("direct", 2.0, pixels=4_000_000)
+    m.record("direct", 2.0)
+    st = m.stages["direct"]
+    assert (st.count, st.total_s, st.pixels) == (2, 4.0, 4_000_000)
+
+
+def test_report_means_and_throughput():
+    m = Metrics()
+    m.record("encode", 2.0, pixels=8_000_000)
+    m.record("encode", 2.0, pixels=8_000_000)
+    m.record("no_pixels", 0.5)
+    report = m.report()
+    assert report["uptime_s"] >= 0
+    enc = report["stages"]["encode"]
+    assert enc["count"] == 2
+    assert enc["total_s"] == pytest.approx(4.0)
+    assert enc["mean_s"] == pytest.approx(2.0)
+    assert enc["max_s"] == pytest.approx(2.0)
+    assert enc["mpixels"] == pytest.approx(16.0)
+    assert enc["mpixels_per_s"] == pytest.approx(4.0)
+    # Stages without pixel counts omit the throughput keys.
+    assert "mpixels" not in report["stages"]["no_pixels"]
+    assert report["stages"]["no_pixels"]["mean_s"] == pytest.approx(0.5)
+
+
+def test_report_empty():
+    report = Metrics().report()
+    assert report["stages"] == {}
+    assert "uptime_s" in report
+
+
+def test_zero_duration_throughput_guard():
+    m = Metrics()
+    m.record("instant", 0.0, pixels=1_000_000)
+    entry = m.report()["stages"]["instant"]
+    assert entry["mpixels"] == pytest.approx(1.0)
+    assert "mpixels_per_s" not in entry       # no divide-by-zero

@@ -1,0 +1,59 @@
+"""Recompile sentinel (analysis/retrace.py): stable shapes must not
+retrace, and unexpected retraces must fail loudly."""
+import numpy as np
+import pytest
+
+from bucketeer_tpu.analysis import retrace
+from bucketeer_tpu.codec import frontend
+from bucketeer_tpu.codec.pipeline import make_plan, run_tiles
+
+
+def _plan(lossless=True):
+    return make_plan(16, 16, 1, 2, lossless, 8)
+
+
+def test_instrument_counts_traces_not_calls():
+    import jax
+
+    calls = retrace.snapshot().get("unit-test-stage", 0)
+    fn = jax.jit(retrace.instrument(
+        "unit-test-stage", lambda x: x * 2))
+    fn(np.float32(1.0))
+    fn(np.float32(2.0))       # same shape/dtype: cached, no retrace
+    assert retrace.snapshot()["unit-test-stage"] - calls == 1
+
+
+def test_transform_stage_stable_across_repeat_batches(rng):
+    plan = _plan()
+    tiles = rng.integers(0, 255, (3, 16, 16), dtype=np.uint8)
+    run_tiles(plan, tiles)                    # warm (bucketed to 4)
+    four = np.concatenate([tiles, tiles[:1]])
+    with retrace.expect_max_retraces(0, stages=("transform",)):
+        run_tiles(plan, tiles)
+        run_tiles(plan, four)                 # same bucket: still 4
+
+
+def test_new_bucket_is_a_detected_retrace(rng):
+    plan = _plan()
+    tiles = rng.integers(0, 255, (3, 16, 16), dtype=np.uint8)
+    run_tiles(plan, tiles)
+    with pytest.raises(retrace.RetraceError) as exc:
+        with retrace.expect_max_retraces(0, stages=("transform",)):
+            big = rng.integers(0, 255, (5, 16, 16), dtype=np.uint8)
+            run_tiles(plan, big)              # bucket 8: new program
+    assert "transform" in str(exc.value)
+
+
+def test_frontend_stage_stable(rng):
+    plan = _plan()
+    tiles = rng.integers(0, 255, (2, 16, 16), dtype=np.uint8)
+
+    def round_trip():
+        res = frontend.run_frontend(plan, tiles)
+        src, _ = frontend.payload_plan(
+            res.nbps, np.zeros_like(res.nbps), res.layout.P)
+        frontend.fetch_payload(res, src)
+
+    round_trip()                              # warm frontend + gather
+    with retrace.expect_max_retraces(0, stages=("frontend", "gather")):
+        round_trip()
