@@ -1,31 +1,116 @@
-"""Benchmark: lossy JP2 encode throughput (BASELINE.json config 1).
+"""Benchmark harness: the BASELINE configs, end to end, on whatever
+backend is available.
 
-Encodes a synthetic photographic 4096x4096 RGB image to a lossy JP2
-(9/7 DWT, 5 levels) end-to-end — device transform + Tier-1 entropy
-coding + Tier-2/boxing — and reports MPixels/s against the 500 MPix/s
-north star (BASELINE.json). Prints exactly one JSON line.
+Measures the product encode path (device transform + Tier-1 entropy
+coding + Tier-2/boxing) against the 500 MPix/s north star
+(BASELINE.json) and prints exactly one JSON line:
 
-Env knobs: BENCH_SIZE (default 4096), BENCH_REPEATS (default 3).
+- config 1: single 4096x4096 RGB -> lossy JP2 with the *real* reference
+  recipe (``-rate 3``, 512x512 tiles, 6 levels, RPCL, 6 layers —
+  KakaduConverter.java:38-44), not the easier untargeted config earlier
+  rounds measured.
+- config 2: batch of 2Kx2K RGB images, lossy 9/7, 5 levels.
+- config 3: lossless RCT-free 5/3 on a 16-bit grayscale archival scan.
+- config 4: sharded-DWT dryrun — the row-sharded multi-level transform
+  (parallel/sharded_dwt.py) over the device mesh; reported as a dryrun
+  number because Tier-1/Tier-2 are excluded.
+- config 5: mixed-size batch with upload overlapped with encode (the
+  S3BucketVerticle-overlap analog: a background writer drains finished
+  encodes while the next image encodes).
+
+Backend init is retried with exponential backoff — the recurring
+``axon ... UNAVAILABLE`` TPU setup error killed BENCH_r02 and r05
+outright — and falls back to CPU after the retries so the harness
+always reports *some* platform-labelled number instead of rc=1.
+
+Env knobs: BENCH_SMOKE=1 shrinks every config to CI-smoke size;
+BENCH_SIZE / BENCH_REPEATS / BENCH_BATCH_N / BENCH_BATCH_SIZE /
+BENCH_SCAN_SIZE / BENCH_SHARD_SIZE / BENCH_CONFIGS (comma list, e.g.
+"1,4") override individual configs; BENCH_BACKEND_RETRIES /
+BENCH_BACKEND_BACKOFF tune the retry ladder.
 """
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 BASELINE_MPIX_S = 500.0
+SMOKE = os.environ.get("BENCH_SMOKE", "") not in ("", "0")
 
 
-def synthetic_photo(size: int, seed: int = 7) -> np.ndarray:
+def _env_int(name: str, default: int, smoke: int | None = None) -> int:
+    if name in os.environ:
+        return int(os.environ[name])
+    return smoke if (SMOKE and smoke is not None) else default
+
+
+# --- backend bring-up ----------------------------------------------------
+
+def _clear_backends() -> None:
+    import jax
+
+    for fn in (getattr(jax, "clear_backends", None),
+               getattr(getattr(getattr(jax, "extend", None), "backend",
+                               None), "clear_backends", None)):
+        if fn is not None:
+            try:
+                fn()
+                return
+            except Exception:
+                continue
+
+
+def init_backend() -> dict:
+    """Bring up a JAX backend, retrying transient TPU setup failures
+    (exponential backoff), then falling back to CPU. Returns platform
+    metadata for the report; raises only if even CPU init fails."""
+    retries = _env_int("BENCH_BACKEND_RETRIES", 3)
+    backoff = float(os.environ.get("BENCH_BACKEND_BACKOFF", "2.0"))
+    errors: list = []
+    import jax
+
+    for attempt in range(retries + 1):
+        try:
+            devices = jax.devices()
+            return {"platform": devices[0].platform,
+                    "n_devices": len(devices),
+                    "attempts": attempt + 1, "fallback": False,
+                    "errors": errors}
+        # RuntimeError is the documented 'Unable to initialize backend'
+        # path; a failed init can also leave xla_bridge half-built so
+        # the *next* call dies on an AssertionError — treat any
+        # exception as a retriable init failure.
+        except Exception as exc:
+            errors.append(f"{type(exc).__name__}: "
+                          + str(exc).split("\n")[0][:200])
+            _clear_backends()
+            if attempt < retries:
+                time.sleep(backoff * (2 ** attempt))
+    # Out of retries: CPU keeps the scoreboard alive (rc=0, labelled).
+    _clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    return {"platform": devices[0].platform, "n_devices": len(devices),
+            "attempts": retries + 1, "fallback": True, "errors": errors}
+
+
+# --- synthetic content ---------------------------------------------------
+
+def synthetic_photo(h: int, w: int | None = None,
+                    seed: int = 7) -> np.ndarray:
     """Photograph-like content: smooth gradients + texture + edges, so the
     entropy coder sees realistic significance statistics."""
+    w = w or h
     rng = np.random.default_rng(seed)
-    y, x = np.mgrid[0:size, 0:size]
-    base = (128 + 96 * np.sin(2 * np.pi * x / size * 3)
-            * np.cos(2 * np.pi * y / size * 2))
-    texture = rng.normal(0, 12, size=(size, size))
+    y, x = np.mgrid[0:h, 0:w]
+    base = (128 + 96 * np.sin(2 * np.pi * x / w * 3)
+            * np.cos(2 * np.pi * y / h * 2))
+    texture = rng.normal(0, 12, size=(h, w))
     edges = ((x // 256 + y // 256) % 2) * 20
     img = np.stack([
         np.clip(base + texture + edges, 0, 255),
@@ -35,44 +120,233 @@ def synthetic_photo(size: int, seed: int = 7) -> np.ndarray:
     return img.astype(np.uint8)
 
 
-def main() -> None:
+def synthetic_scan16(size: int, seed: int = 11) -> np.ndarray:
+    """16-bit grayscale archival-scan-like content (BASELINE config 3)."""
+    rng = np.random.default_rng(seed)
+    y, x = np.mgrid[0:size, 0:size]
+    base = 32768 + 18000 * np.sin(x / 37.0) * np.cos(y / 29.0)
+    grain = rng.normal(0, 600, size=(size, size))
+    return np.clip(base + grain, 0, 65535).astype(np.uint16)
+
+
+def _timed(fn, repeats: int) -> tuple:
+    """(best seconds, last result) over ``repeats`` runs after the
+    caller's warmup."""
+    best, result = float("inf"), None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        result = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, result
+
+
+# --- configs -------------------------------------------------------------
+
+def config1_single_4k(repeats: int) -> dict:
+    """BASELINE config 1, real recipe: 4096x4096 RGB -> lossy `-rate 3`,
+    512 tiles, 6 levels, RPCL, 6 layers, SOP/EPH/PLT."""
     from bucketeer_tpu.codec import encoder
     from bucketeer_tpu.codec.encoder import EncodeParams
 
-    size = int(os.environ.get("BENCH_SIZE", "4096"))
-    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+    size = _env_int("BENCH_SIZE", 4096, smoke=512)
     img = synthetic_photo(size)
-    params = EncodeParams(lossless=False, levels=5, tile_size=1024,
-                          base_delta=2.0)
-
-    # Warmup: trigger XLA compilation so the steady-state rate is measured.
-    encoder.encode_jp2(img[:1024, :1024], 8, params)
-
-    times = []
-    n_bytes = 0
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        data = encoder.encode_jp2(img, 8, params)
-        times.append(time.perf_counter() - t0)
-        n_bytes = len(data)
-
+    params = EncodeParams.kakadu_recipe(lossless=False, rate=3.0)
+    # Warm with the real geometry: a smaller slab would dispatch
+    # different chunk/batch-bucket program variants and leave XLA
+    # compiles inside the first timed repeat.
+    encoder.encode_jp2(img, 8, params)
+    best, data = _timed(lambda: encoder.encode_jp2(img, 8, params),
+                        repeats)
     mpix = size * size / 1e6
-    best = min(times)
-    value = mpix / best
+    return {"value": round(mpix / best, 3), "unit": "MPix/s",
+            "seconds": round(best, 3),
+            "image": f"{size}x{size}x3 uint8",
+            "recipe": "kakadu rate=3 tiles=512 levels=6",
+            "output_bytes": len(data),
+            "bpp": round(8.0 * len(data) / (size * size), 3),
+            "repeats": repeats}
+
+
+def config2_batch_2k(repeats: int) -> dict:
+    """BASELINE config 2 (scaled by env): N 2Kx2K RGB images, lossy
+    CDF 9/7, 5 DWT levels, aggregate throughput."""
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+
+    n = _env_int("BENCH_BATCH_N", 8, smoke=2)
+    size = _env_int("BENCH_BATCH_SIZE", 2048, smoke=256)
+    imgs = [synthetic_photo(size, seed=100 + i) for i in range(n)]
+    params = EncodeParams(lossless=False, levels=5, tile_size=1024,
+                          base_delta=2.0, rate=3.0)
+    encoder.encode_jp2(imgs[0], 8, params)                 # compile
+
+    def run():
+        return sum(len(encoder.encode_jp2(im, 8, params)) for im in imgs)
+
+    best, total_bytes = _timed(run, repeats)
+    mpix = n * size * size / 1e6
+    return {"value": round(mpix / best, 3), "unit": "MPix/s",
+            "seconds": round(best, 3), "images": n,
+            "image": f"{size}x{size}x3 uint8",
+            "output_bytes": total_bytes, "repeats": repeats}
+
+
+def config3_lossless16(repeats: int) -> dict:
+    """BASELINE config 3: lossless 5/3 on a 16-bit grayscale scan."""
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+
+    size = _env_int("BENCH_SCAN_SIZE", 2048, smoke=256)
+    img = synthetic_scan16(size)
+    params = EncodeParams(lossless=True, levels=5,
+                          tile_size=min(1024, size))
+    encoder.encode_jp2(img, 16, params)    # warm the real geometry
+    best, data = _timed(lambda: encoder.encode_jp2(img, 16, params),
+                        repeats)
+    mpix = size * size / 1e6
+    return {"value": round(mpix / best, 3), "unit": "MPix/s",
+            "seconds": round(best, 3),
+            "image": f"{size}x{size} uint16",
+            "output_bytes": len(data),
+            "bpp": round(8.0 * len(data) / (size * size), 3),
+            "repeats": repeats}
+
+
+def config4_sharded_dryrun(repeats: int) -> dict:
+    """BASELINE config 4 dryrun: the row-sharded multi-level DWT over
+    the full device mesh (the 20000x20000 map-scan transform), Tier-1/2
+    excluded — hence 'dryrun', not a full-encode number."""
+    import jax
+    import jax.numpy as jnp
+
+    from bucketeer_tpu.parallel import make_mesh, sharded_dwt2d_forward
+    from bucketeer_tpu.parallel.sharded_dwt import can_row_shard
+
+    size = _env_int("BENCH_SHARD_SIZE", 8192, smoke=512)
+    n_dev = len(jax.devices())
+    levels = 5
+    while levels > 1 and not can_row_shard(size, levels, max(n_dev, 2)):
+        levels -= 1
+    shards = n_dev if n_dev > 1 and can_row_shard(size, levels,
+                                                  n_dev) else 1
+    mesh = make_mesh(tile_parallel=shards)
+    img = synthetic_scan16(size).astype(np.int32)
+
+    def run():
+        if shards > 1:
+            ll, bands = sharded_dwt2d_forward(jnp.asarray(img), levels,
+                                              True, mesh)
+        else:
+            from bucketeer_tpu.codec.dwt import dwt2d_forward
+            ll, bands = dwt2d_forward(jnp.asarray(img), levels, True)
+        jax.block_until_ready(ll)
+        return ll
+
+    run()                                                  # compile
+    best, _ = _timed(run, repeats)
+    mpix = size * size / 1e6
+    return {"value": round(mpix / best, 3), "unit": "MPix/s",
+            "seconds": round(best, 4), "dryrun": True,
+            "stage": "sharded multi-level 5/3 DWT only",
+            "image": f"{size}x{size} int32", "levels": levels,
+            "shards": shards, "repeats": repeats}
+
+
+def config5_mixed_overlap(repeats: int) -> dict:
+    """BASELINE config 5 analog: mixed-size batch, 'upload' (durable
+    local write, the FakeS3 stand-in) overlapped with the next encode."""
+    import tempfile
+
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+
+    if SMOKE and "BENCH_MIXED_SIZES" not in os.environ:
+        sizes = [256, 128, 192]
+    else:
+        sizes = [int(s) for s in os.environ.get(
+            "BENCH_MIXED_SIZES", "2048,1024,1536,768").split(",")]
+    imgs = [synthetic_photo(s, seed=200 + i)
+            for i, s in enumerate(sizes)]
+    params = EncodeParams(lossless=False, levels=5, tile_size=1024,
+                          base_delta=2.0, rate=3.0)
+    for im in imgs:
+        encoder.encode_jp2(im, 8, params)                  # compile all
+
+    def upload(data: bytes, path: str) -> None:
+        with open(path, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def run():
+        total = 0
+        with tempfile.TemporaryDirectory() as tmp, \
+                ThreadPoolExecutor(max_workers=2) as pool:
+            futs = []
+            for i, im in enumerate(imgs):
+                data = encoder.encode_jp2(im, 8, params)
+                total += len(data)
+                futs.append(pool.submit(
+                    upload, data, os.path.join(tmp, f"{i}.jp2")))
+            for f in futs:
+                f.result()
+        return total
+
+    best, total_bytes = _timed(run, repeats)
+    mpix = sum(s * s for s in sizes) / 1e6
+    return {"value": round(mpix / best, 3), "unit": "MPix/s",
+            "seconds": round(best, 3), "sizes": sizes,
+            "output_bytes": total_bytes, "repeats": repeats,
+            "overlap": "upload behind encode"}
+
+
+CONFIGS = {
+    "1_single_4k_rate3": config1_single_4k,
+    "2_batch_2k_lossy": config2_batch_2k,
+    "3_lossless_16bit": config3_lossless16,
+    "4_sharded_dwt_dryrun": config4_sharded_dryrun,
+    "5_mixed_upload_overlap": config5_mixed_overlap,
+}
+
+
+def main() -> int:
+    backend = init_backend()
+    # CPU (dev mode / fallback) is ~500x off the accelerator: keep the
+    # default sweep under ~5 minutes there. Explicit env always wins,
+    # and BENCH_SMOKE's own (smaller) scaling takes precedence.
+    if backend["platform"] == "cpu" and not SMOKE:
+        os.environ.setdefault("BENCH_BATCH_N", "4")
+    repeats = _env_int(
+        "BENCH_REPEATS", 3 if backend["platform"] != "cpu" else 1,
+        smoke=1)
+    wanted = os.environ.get("BENCH_CONFIGS", "")
+    selected = ({k: f for k, f in CONFIGS.items()
+                 if k.split("_")[0] in wanted.split(",")} if wanted
+                else CONFIGS)
+
+    results: dict = {}
+    for name, fn in selected.items():
+        try:
+            results[name] = fn(repeats)
+        except Exception as exc:                    # keep the scoreboard
+            results[name] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    headline = results.get("1_single_4k_rate3", {})
+    value = headline.get("value", 0.0)
     print(json.dumps({
         "metric": "lossy_jp2_encode_throughput",
-        "value": round(value, 3),
+        "value": value,
         "unit": "MPix/s",
         "vs_baseline": round(value / BASELINE_MPIX_S, 4),
-        "detail": {
-            "image": f"{size}x{size}x3 uint8",
-            "seconds": round(best, 3),
-            "output_bytes": n_bytes,
-            "bpp": round(8.0 * n_bytes / (size * size), 3),
-            "repeats": repeats,
-        },
+        "platform": backend["platform"],
+        "n_devices": backend["n_devices"],
+        "backend": backend,
+        "smoke": SMOKE,
+        "configs": results,
     }))
+    ok = any("value" in r for r in results.values())
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

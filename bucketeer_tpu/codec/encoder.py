@@ -27,6 +27,10 @@ absent (reference: converters/ConverterFactory.java:37-47).
 from __future__ import annotations
 
 import math
+import os
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -42,6 +46,37 @@ from .pipeline import TilePlan, make_plan
 from .quant import GUARD_BITS, SubbandQuant
 
 CBLK_EXP = 6  # 64x64 code-blocks (reference recipe Cblk={64,64})
+
+# --- overlapped pipeline knobs -------------------------------------------
+# The encoder is a two-stage pipeline: the jitted device front-end
+# (transform + blockify + bit-plane pack) and host Tier-1 entropy coding
+# (native thread pool). Tile groups are split into chunks of
+# BUCKETEER_OVERLAP_TILES tiles; while chunk N's packed payload is coded
+# on the host worker, chunk N+1's device program is already dispatched
+# (JAX async dispatch), so host entropy coding hides behind device
+# compute (SURVEY.md §7 hard part 6).
+OVERLAP_DEPTH = 2       # dispatched-but-unfetched chunks (staging buffers)
+HOST_QUEUE_DEPTH = 2    # unfinished host-coding jobs before back-pressure
+
+
+def _overlap_tiles() -> int:
+    """Tiles per pipeline chunk. Power-of-two keeps the batch bucketing
+    (pipeline._bucket) from compiling extra program variants."""
+    return max(1, int(os.environ.get("BUCKETEER_OVERLAP_TILES", "8")))
+
+
+# Optional per-stage timing/counter sink (server.metrics.Metrics). The
+# server installs its instance at boot so /metrics shows the encoder's
+# device-dispatch vs host-coding segments and the measured overlap.
+_metrics_sink = None
+
+
+def set_metrics_sink(sink) -> None:
+    """Install a metrics sink with ``record(stage, seconds, pixels=0)``,
+    ``record_overlap(stage, device_s, host_s, wall_s, pixels=0)`` and
+    ``count(name, n=1)`` (server.metrics.Metrics). None disables."""
+    global _metrics_sink
+    _metrics_sink = sink
 
 
 @dataclass
@@ -235,26 +270,39 @@ class _Band:
                 self.by0 >> CBLK_EXP, ((self.by1 - 1) >> CBLK_EXP) + 1)
 
 
-def _grid_aligned(plan: TilePlan, origin: tuple) -> bool:
-    """True when every sub-band block of a tile at ``origin`` lands on
-    the global 64-grid exactly where the device front-end's band-local
-    blockification puts it (no global cell boundary cuts a band's
-    interior). Holds for power-of-two tile grids; odd tile sizes fall
-    back to the host Tier-1 path (_legacy_tier1)."""
+def _grid_aligned(plan: TilePlan, origin: tuple) -> str:
+    """Classify a tile at ``origin`` for the Tier-1 path choice:
+
+    - ``"ok"``: every sub-band block lands on the global 64-grid exactly
+      where the device front-end's band-local blockification puts it (no
+      global cell boundary cuts a band's interior) — the packed device
+      path applies. Holds for power-of-two tile grids.
+    - ``"straddle"``: band geometry matches the local Mallat layout but a
+      global 64-grid cell boundary cuts a band's interior (e.g. tile 96
+      at 2 levels) — the host Tier-1 path (_legacy_tier1) slices blocks
+      against the global cell grid instead.
+    - ``"mismatch"``: the tile's *global* band rectangle disagrees with
+      the local Mallat geometry (tile size not divisible by 2^levels,
+      e.g. tile 50 at 2 levels: global LL height 12 vs local 13). No
+      path can code such a tile — the device produces band arrays of the
+      wrong shape — so encode_array raises NotImplementedError instead
+      of letting _legacy_tier1 die on an alignment assert downstream.
+    """
     y0, x0 = origin
     tcx1, tcy1 = x0 + plan.tile_w, y0 + plan.tile_h
     cb = 1 << CBLK_EXP
+    state = "ok"
     for slot in plan.slots:
         bx0, bx1, by0, by1 = _band_rect(x0, tcx1, y0, tcy1,
                                         slot.resolution, slot.name,
                                         plan.levels)
         if (by1 - by0, bx1 - bx0) != (slot.h, slot.w):
-            return False
+            return "mismatch"
         if by0 % cb and (by0 % cb) + slot.h > cb:
-            return False
+            state = "straddle"
         if bx0 % cb and (bx0 % cb) + slot.w > cb:
-            return False
-    return True
+            state = "straddle"
+    return state
 
 
 def _collect_blocks(band: _Band, specs: list, dests: list) -> None:
@@ -486,14 +534,39 @@ def _band_weight(slot, gains) -> float:
 
 def _legacy_tier1(groups: dict, plans: dict, img: np.ndarray,
                   params: EncodeParams, bitdepth: int, n_comps: int,
-                  used_mct: bool, gains, weight_of_slot: dict):
-    """Host-side Tier-1 for tile grids the device front-end cannot
-    blockify (sub-bands straddling global 64-grid cells, i.e.
-    non-power-of-two tile sizes): raw coefficient planes come back from
-    the device and code-blocks are sliced on the host, clipped to the
-    global cell grid. Returns (tile_records, coded blocks, weights,
-    qcd_values)."""
+                  used_mct: bool, gains, weight_of_slot: dict,
+                  mesh=None):
+    """Host-side Tier-1 over raw coefficient planes. Two callers:
+
+    - tile grids whose sub-bands *straddle* global 64-grid cells (tile
+      size divisible by 2^levels but not a multiple of 64, e.g. 96): the
+      device front-end cannot blockify these, so code-blocks are sliced
+      on the host, clipped to the global cell grid. Tile sizes whose
+      global band rects disagree with the local Mallat geometry never
+      reach here — encode_array raises NotImplementedError for those.
+    - mesh-sharded encodes (``mesh`` not None): the transform runs
+      data-parallel over the mesh (parallel.batch.run_tiles_sharded), or
+      row-sharded with DWT halo exchange for a single giant tile
+      (parallel.sharded_dwt.sharded_transform_tile), and the planes come
+      back for host block slicing.
+
+    Returns (tile_records, coded blocks, weights, qcd_values)."""
     from .pipeline import extract_bands, run_tiles
+
+    if mesh is not None:
+        from ..parallel.batch import run_tiles_sharded
+        from ..parallel.mesh import TILE_AXIS
+        from ..parallel.sharded_dwt import (can_row_shard,
+                                            sharded_transform_tile)
+
+    def transform(plan: TilePlan, batch: np.ndarray) -> np.ndarray:
+        if mesh is None:
+            return run_tiles(plan, batch)
+        n_rows = mesh.shape[TILE_AXIS]
+        if (batch.shape[0] == 1 and n_rows > 1
+                and can_row_shard(plan.tile_h, plan.levels, n_rows)):
+            return sharded_transform_tile(plan, batch[0], mesh)[None]
+        return run_tiles_sharded(plan, batch, mesh)
 
     specs: list = []
     dests: list = []
@@ -504,7 +577,7 @@ def _legacy_tier1(groups: dict, plans: dict, img: np.ndarray,
         plan = plans[(th, tw)]
         batch = np.stack([img[y0:y0 + th, x0:x0 + tw]
                           for _, y0, x0 in members])
-        planes = run_tiles(plan, batch)
+        planes = transform(plan, batch)
         if qcd_values is None:
             qcd_values = _qcd_values(plan)
         for s in plan.slots:
@@ -549,11 +622,75 @@ def _legacy_tier1(groups: dict, plans: dict, img: np.ndarray,
     return tile_records, blocks, weights, qcd_values
 
 
+@dataclass
+class _Chunk:
+    """One unit of the overlapped pipeline: up to BUCKETEER_OVERLAP_TILES
+    same-shape tiles plus the host-side metadata joining the device's
+    canonical block order to Tier-2's cells."""
+    plan: TilePlan
+    members: list            # [(tidx, y0, x0)]
+    dests: list              # [(band, cy, cx)] in frontend block order
+    hs: np.ndarray
+    ws: np.ndarray
+    bandnames: list
+    wts: np.ndarray          # PCRD distortion weight per block
+    ns: np.ndarray           # true samples per block
+    pending: object = None   # frontend.PendingFrontend while dispatched
+    fres: object = None      # frontend.FrontendResult once resolved
+
+
+def _build_chunks(groups: dict, plans: dict, used_mct: bool, gains,
+                  weight_of_slot: dict, norms) -> tuple:
+    """Split shape groups into pipeline chunks (order is deterministic:
+    group dict order, then member order — byte-identical output to the
+    unchunked encoder). Returns (chunks, tile_records, qcd_values)."""
+    chunk_tiles = _overlap_tiles()
+    tile_records: list = []
+    chunks: list = []
+    qcd_values = None
+    for (th, tw), members in groups.items():
+        plan = plans[(th, tw)]
+        if qcd_values is None:
+            qcd_values = _qcd_values(plan)
+        for s in plan.slots:
+            weight_of_slot.setdefault((s.resolution, s.name),
+                                      _band_weight(s, gains))
+        layout = frontend.layout_for(plan)
+        for i in range(0, len(members), chunk_tiles):
+            part = members[i:i + chunk_tiles]
+            dests, hs, ws, bandnames, wts, ns = [], [], [], [], [], []
+            for (tidx, y0, x0) in part:
+                comp_res, band_of_slot = _tile_bands(plan, (y0, x0))
+                tile_records.append((tidx, (y0, x0), plan, comp_res))
+                for m in layout.metas:
+                    band = band_of_slot[(m.comp, m.slot_i)]
+                    cx0, _, cy0, _ = band.cell_range
+                    dests.append((band, cy0 + m.iy, cx0 + m.ix))
+                    hs.append(m.h)
+                    ws.append(m.w)
+                    bandnames.append(band.name)
+                    cw = norms[m.comp] ** 2 if used_mct else 1.0
+                    wts.append(weight_of_slot[(band.res, band.name)] * cw)
+                    ns.append(m.h * m.w)
+            chunks.append(_Chunk(plan, part, dests,
+                                 np.asarray(hs, np.int32),
+                                 np.asarray(ws, np.int32), bandnames,
+                                 np.asarray(wts), np.asarray(ns)))
+    return chunks, tile_records, qcd_values
+
+
 @contract(shapes={"img": [("H", "W"), ("H", "W", "C")]},
           dtypes={"img": "number"})
 def encode_array(img: np.ndarray, bitdepth: int = 8,
-                 params: EncodeParams | None = None) -> bytes:
-    """Encode a (H, W) or (H, W, 3) array into a raw JPEG 2000 codestream."""
+                 params: EncodeParams | None = None, mesh=None) -> bytes:
+    """Encode a (H, W) or (H, W, 3) array into a raw JPEG 2000 codestream.
+
+    ``mesh``: optional jax Mesh (parallel.mesh.make_mesh). When given,
+    the sample transform runs sharded across the mesh — data-parallel
+    over tile batches, or row-sharded with DWT halo exchange for a
+    single giant tile — and Tier-1 runs on host planes. None (default)
+    uses the single-device overlapped packed-frontend pipeline.
+    """
     params = params or EncodeParams()
     h, w = img.shape[:2]
     n_comps = 1 if img.ndim == 2 else img.shape[2]
@@ -596,115 +733,170 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
                               params.lossless, bitdepth, params.base_delta,
                               use_mct=used_mct) for shape in groups}
 
-    if not all(_grid_aligned(plans[shape], (y0, x0))
-               for shape, members in groups.items()
-               for _, y0, x0 in members):
-        # Odd tile grids: host-side block slicing (no device packing).
+    states = {_grid_aligned(plans[shape], (y0, x0))
+              for shape, members in groups.items()
+              for _, y0, x0 in members}
+    if "mismatch" in states:
+        raise NotImplementedError(
+            f"tile size {tile} with {levels} decomposition levels: the "
+            "global band rectangle of a tile disagrees with its local "
+            "Mallat geometry, so neither the device front-end nor the "
+            "host fallback can code it. Use a tile size divisible by "
+            f"2^levels ({1 << levels}), or fewer levels.")
+    if mesh is not None or "straddle" in states:
+        # Host-side block slicing: sharded transforms (mesh) or tile
+        # grids whose sub-bands straddle global 64-grid cells.
         tile_records, all_blocks, block_weights, qcd_values = \
             _legacy_tier1(groups, plans, img, params, bitdepth, n_comps,
-                          used_mct, gains, weight_of_slot)
+                          used_mct, gains, weight_of_slot, mesh=mesh)
         assign_index = {id(b): i for i, b in enumerate(all_blocks)}
         return _finish(img, params, tile_records, all_blocks,
                        block_weights, assign_index, qcd_values, used_mct,
                        bitdepth, n_comps, levels, tile, target)
 
-    # Phase A: device front-end per shape group — fused transform,
-    # blockification, per-plane stats, bit-plane bitmaps packed on
-    # device (codec/frontend.py). Only the small stats come back here;
-    # the bitmaps stay in HBM until the floors are known.
-    tile_records = []
-    qcd_values = None
-    group_runs: list = []    # (plan, result, dests, hs, ws, bands, wts, ns)
-    for (th, tw), members in groups.items():
-        plan = plans[(th, tw)]
-        batch = np.stack([img[y0:y0 + th, x0:x0 + tw]
-                          for _, y0, x0 in members])
-        fres = frontend.run_frontend(plan, batch)
-        if qcd_values is None:
-            qcd_values = _qcd_values(plan)
-        for s in plan.slots:
-            weight_of_slot.setdefault((s.resolution, s.name),
-                                      _band_weight(s, gains))
-        layout = fres.layout
-        dests, hs, ws, bandnames, wts, ns = [], [], [], [], [], []
-        for (tidx, y0, x0) in members:
-            comp_res, band_of_slot = _tile_bands(plan, (y0, x0))
-            tile_records.append((tidx, (y0, x0), plan, comp_res))
-            for m in layout.metas:
-                band = band_of_slot[(m.comp, m.slot_i)]
-                cx0, _, cy0, _ = band.cell_range
-                dests.append((band, cy0 + m.iy, cx0 + m.ix))
-                hs.append(m.h)
-                ws.append(m.w)
-                bandnames.append(band.name)
-                cw = norms[m.comp] ** 2 if used_mct else 1.0
-                wts.append(weight_of_slot[(band.res, band.name)] * cw)
-                ns.append(m.h * m.w)
-        group_runs.append((plan, fres, dests, np.asarray(hs, np.int32),
-                           np.asarray(ws, np.int32), bandnames,
-                           np.asarray(wts), np.asarray(ns)))
+    # Overlapped device/host pipeline. Device front-end per chunk —
+    # fused transform, blockification, per-plane stats, bit-plane
+    # bitmaps packed on device (codec/frontend.py); host Tier-1 over
+    # the compacted payload on a bounded worker. Only the small stats
+    # come back eagerly; bitmaps stay in HBM until floors are known.
+    chunks, tile_records, qcd_values = _build_chunks(
+        groups, plans, used_mct, gains, weight_of_slot, norms)
 
-    # Bit-plane floors: with a rate target, skip coding (and transfer)
-    # of planes PCRD-opt would discard; without one, code everything.
-    def group_floors(margin: float) -> list:
+    tm = {"device": 0.0, "host": 0.0}
+    t_wall0 = time.perf_counter()
+
+    def dispatch(chunk: _Chunk) -> None:
+        t0 = time.perf_counter()
+        batch = np.stack([img[y0:y0 + chunk.plan.tile_h,
+                              x0:x0 + chunk.plan.tile_w]
+                          for _, y0, x0 in chunk.members])
+        chunk.pending = frontend.dispatch_frontend(chunk.plan, batch)
+        tm["device"] += time.perf_counter() - t0
+
+    def resolve(chunk: _Chunk) -> None:
+        t0 = time.perf_counter()
+        chunk.fres = chunk.pending.resolve_stats()
+        chunk.pending = None
+        tm["device"] += time.perf_counter() - t0
+
+    def host_code(chunk: _Chunk, floors: np.ndarray, payload: np.ndarray,
+                  offsets: np.ndarray) -> list:
+        """Runs on the bounded worker; native Tier-1 releases the GIL,
+        so this overlaps the caller's device dispatch/waits."""
+        t0 = time.perf_counter()
+        blocks = t1_batch.encode_packed(payload, offsets, chunk.fres.nbps,
+                                        floors, chunk.hs, chunk.ws,
+                                        chunk.bandnames)
+        if not params.lossless:
+            _correct_distortions(blocks, chunk.fres)
+        tm["host"] += time.perf_counter() - t0
+        return blocks
+
+    def fetch_and_submit(pool, chunk: _Chunk, floors: np.ndarray,
+                         futs: list, release_rows: bool) -> None:
+        t0 = time.perf_counter()
+        src, offsets = frontend.payload_plan(chunk.fres.nbps, floors,
+                                             chunk.fres.layout.P)
+        payload = frontend.fetch_payload(chunk.fres, src)
+        tm["device"] += time.perf_counter() - t0
+        if release_rows:
+            chunk.fres.rows = None      # free the staging buffer in HBM
+        # Back-pressure: at most HOST_QUEUE_DEPTH unfinished host jobs
+        # so payload staging stays bounded.
+        live = [f for f in futs if not f.done()]
+        if len(live) > HOST_QUEUE_DEPTH:
+            live[0].result()
+        futs.append(pool.submit(host_code, chunk, floors, payload,
+                                offsets))
+
+    def chunk_floors(margin: float) -> list:
         if target is None:
-            return [np.zeros(fr.n_blocks, np.int32)
-                    for _, fr, *_ in group_runs]
+            return [np.zeros(c.fres.n_blocks, np.int32) for c in chunks]
         # Plane capacity could in principle differ between shape
         # groups; pad the per-plane stats to the widest.
-        pmax = max(fr.layout.P for _, fr, *_ in group_runs)
+        pmax = max(c.fres.layout.P for c in chunks)
 
         def padp(a):
             return np.pad(a, ((0, 0), (0, pmax - a.shape[1])))
 
-        nbps = np.concatenate([fr.nbps for _, fr, *_ in group_runs])
-        newsig = np.concatenate([padp(fr.newsig)
-                                 for _, fr, *_ in group_runs])
-        sigd = np.concatenate([padp(fr.sigd) for _, fr, *_ in group_runs])
-        refd = np.concatenate([padp(fr.refd) for _, fr, *_ in group_runs])
-        wts = np.concatenate([g[6] for g in group_runs])
-        ns = np.concatenate([g[7] for g in group_runs])
+        nbps = np.concatenate([c.fres.nbps for c in chunks])
+        newsig = np.concatenate([padp(c.fres.newsig) for c in chunks])
+        sigd = np.concatenate([padp(c.fres.sigd) for c in chunks])
+        refd = np.concatenate([padp(c.fres.refd) for c in chunks])
+        wts = np.concatenate([c.wts for c in chunks])
+        ns = np.concatenate([c.ns for c in chunks])
         floors = rate_mod.estimate_floors(nbps, newsig, sigd, refd,
                                           wts, ns, target, margin)
         out, ofs = [], 0
-        for _, fr, *_ in group_runs:
-            out.append(floors[ofs:ofs + fr.n_blocks])
-            ofs += fr.n_blocks
+        for c in chunks:
+            out.append(floors[ofs:ofs + c.fres.n_blocks])
+            ofs += c.fres.n_blocks
         return out
 
-    # Phase B: compact exactly the needed bitmap rows on device, copy
-    # them host-side, and run native Tier-1 over the packed payload.
-    # If the floors were too aggressive for the byte target (estimator
-    # undershoot), lower them and redo — PCRD needs enough passes to
-    # spend the budget.
-    margin = 3.0
-    for attempt in range(3):
-        floors_by_group = group_floors(margin)
-        all_blocks = []
-        for (plan, fr, dests, hs, ws, bandnames, wts, ns), floors in zip(
-                group_runs, floors_by_group):
-            src, offsets = frontend.payload_plan(fr.nbps, floors,
-                                                 fr.layout.P)
-            payload = frontend.fetch_payload(fr, src)
-            blocks = t1_batch.encode_packed(payload, offsets, fr.nbps,
-                                            floors, hs, ws, bandnames)
-            if not params.lossless:
-                _correct_distortions(blocks, fr)
-            all_blocks.append(blocks)
+    with ThreadPoolExecutor(max_workers=1) as pool:
         if target is None:
-            break
-        avail = sum(len(b.data) for blocks in all_blocks for b in blocks)
-        if avail >= 1.05 * target:
-            break
-        margin *= 4.0
-    group_runs_meta = [(g[2], g[6]) for g in group_runs]
-    del group_runs        # release the device-side bitmap rows
+            # Streaming: floors are all zero, so each chunk flows
+            # dispatch -> resolve -> fetch -> host-code independently;
+            # at most OVERLAP_DEPTH chunks staged in HBM (the rows
+            # buffer is released as soon as its payload is fetched).
+            futs: list = []
+            staged: deque = deque()
+            for chunk in chunks:
+                dispatch(chunk)
+                staged.append(chunk)
+                if len(staged) >= OVERLAP_DEPTH:
+                    c = staged.popleft()
+                    resolve(c)
+                    fetch_and_submit(pool, c, np.zeros(
+                        c.fres.n_blocks, np.int32), futs,
+                        release_rows=True)
+            while staged:
+                c = staged.popleft()
+                resolve(c)
+                fetch_and_submit(pool, c, np.zeros(
+                    c.fres.n_blocks, np.int32), futs, release_rows=True)
+            blocks_by_chunk = [f.result() for f in futs]
+        else:
+            # Rate-targeted: floors need global stats, so phase A
+            # queues every chunk's device program (rows stay resident —
+            # a later margin attempt may re-fetch deeper planes), then
+            # phase B overlaps per-chunk payload fetch with host coding.
+            for chunk in chunks:
+                dispatch(chunk)
+            for chunk in chunks:
+                resolve(chunk)
+            margin = 3.0
+            for attempt in range(3):
+                if attempt and _metrics_sink is not None:
+                    _metrics_sink.count("encode.floor_reruns")
+                floors_by_chunk = chunk_floors(margin)
+                futs = []
+                for chunk, floors in zip(chunks, floors_by_chunk):
+                    fetch_and_submit(pool, chunk, floors, futs,
+                                     release_rows=False)
+                blocks_by_chunk = [f.result() for f in futs]
+                avail = sum(len(b.data) for blocks in blocks_by_chunk
+                            for b in blocks)
+                if avail >= 1.05 * target:
+                    break
+                # Estimator undershoot: lower the floors and redo —
+                # PCRD needs enough passes to spend the budget.
+                margin *= 4.0
+
+    wall_s = time.perf_counter() - t_wall0
+    if _metrics_sink is not None:
+        _metrics_sink.record("encode.device_dispatch", tm["device"],
+                             pixels=h * w)
+        _metrics_sink.record("encode.host_code", tm["host"], pixels=h * w)
+        _metrics_sink.record_overlap("encode", tm["device"], tm["host"],
+                                     wall_s, pixels=h * w)
 
     all_coded: list = []
     block_weights: list = []
     assign_index: dict = {}     # id(CodedBlock) -> index
-    for (dests, wts), blocks in zip(group_runs_meta, all_blocks):
-        for (band, cy, cx), blk, bw in zip(dests, blocks, wts):
+    for chunk, blocks in zip(chunks, blocks_by_chunk):
+        for (band, cy, cx), blk, bw in zip(chunk.dests, blocks,
+                                           chunk.wts):
             assert blk.n_bitplanes <= band.q.n_bitplanes, (
                 f"block bitplanes {blk.n_bitplanes} exceed Mb "
                 f"{band.q.n_bitplanes} in {band.name}")
@@ -712,8 +904,8 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
             assign_index[id(blk)] = len(all_coded)
             all_coded.append(blk)
             block_weights.append(bw)
-    all_blocks = all_coded
-    return _finish(img, params, tile_records, all_blocks, block_weights,
+        chunk.fres = None         # release stats + any remaining rows
+    return _finish(img, params, tile_records, all_coded, block_weights,
                    assign_index, qcd_values, used_mct, bitdepth, n_comps,
                    levels, tile, target)
 
@@ -767,6 +959,10 @@ def _finish(img: np.ndarray, params: EncodeParams, tile_records: list,
         if abs(err) <= 0.02 * target:
             break
         budget = max(1024.0, budget - err)
+        # Each extra Tier-2 rebuild multiplies worst-case encode cost;
+        # count them so adversarial-content blowups are observable.
+        if _metrics_sink is not None:
+            _metrics_sink.count("encode.t2_rebuilds")
         out = build(budget)
     return out
 
@@ -818,9 +1014,10 @@ def _qcd_values(plan: TilePlan) -> list:
 @contract(shapes={"img": [("H", "W"), ("H", "W", "C")]},
           dtypes={"img": "number"})
 def encode_jp2(img: np.ndarray, bitdepth: int = 8,
-               params: EncodeParams | None = None, jpx: bool = False) -> bytes:
+               params: EncodeParams | None = None, jpx: bool = False,
+               mesh=None) -> bytes:
     """Encode to a boxed .jp2 / .jpx file image."""
-    code = encode_array(img, bitdepth, params)
+    code = encode_array(img, bitdepth, params, mesh=mesh)
     h, w = img.shape[:2]
     n_comps = 1 if img.ndim == 2 else img.shape[2]
     return jp2box.wrap(code, w, h, n_comps, bitdepth, jpx=jpx)

@@ -61,6 +61,7 @@ class FrontendLayout:
     plan: TilePlan
     metas: tuple          # tuple[BlockMeta], length n_per_tile
     P: int                # plane capacity (max Mb over subbands)
+    mb_caps: tuple        # per-meta subband Mb (guard-bit ceiling)
 
     @property
     def n_per_tile(self) -> int:
@@ -74,6 +75,7 @@ def layout_for(plan: TilePlan) -> FrontendLayout:
     of encoder._tile_bands so host metadata lines up index-for-index
     with the device's concatenated block axis."""
     metas = []
+    caps = []
     for c in range(plan.n_comps):
         for si, s in enumerate(plan.slots):
             nby = -(-s.h // CBLK) if s.h else 0
@@ -84,8 +86,9 @@ def layout_for(plan: TilePlan) -> FrontendLayout:
                         c, si, iy, ix,
                         min(CBLK, s.h - iy * CBLK),
                         min(CBLK, s.w - ix * CBLK)))
+                    caps.append(s.quant.n_bitplanes)
     P = max((s.quant.n_bitplanes for s in plan.slots), default=1)
-    return FrontendLayout(plan, tuple(metas), P)
+    return FrontendLayout(plan, tuple(metas), P, tuple(caps))
 
 
 def _blockify(planes: jnp.ndarray, plan: TilePlan) -> jnp.ndarray:
@@ -141,17 +144,21 @@ def _frontend_body(plan: TilePlan, P: int, frac_bits: int,
         is_new = (hi != 0) & ((idx >> (p + 1)) == 0)
         already = (idx >> (p + 1)) != 0
         newsig.append(is_new.sum(axis=(1, 2), dtype=jnp.int32))
-        # Significance at plane p reconstructs to 1.5 * 2^p.
+        # Significance at plane p reconstructs to 1.5 * 2^p. Expanded,
+        # cancellation-free form: tv² - (tv-r)² computed directly loses
+        # float32 precision for high-Mb content (tv ~ 2^18 gives ~1%
+        # per-sample error), and these sums replace the host's exact
+        # distortions for PCRD slope ranking.
         r = jnp.float32(1.5 * (1 << p))
-        sd = jnp.where(is_new, tv * tv - (tv - r) * (tv - r), 0.0)
+        sd = jnp.where(is_new, r * (2.0 * tv - r), 0.0)
         sigd.append(sd.sum(axis=(1, 2), dtype=jnp.float32))
         # Refinement halves the uncertainty interval (t1.ref_dist).
+        # (tv-r1)² - (tv-r0)² in expanded form for the same reason.
         v1 = ((idx >> (p + 1)) << (p + 1)).astype(jnp.float32)
         v0 = ((idx >> p) << p).astype(jnp.float32)
         r1 = v1 + jnp.float32(1 << p)
         r0 = v0 + jnp.float32(0.5 * (1 << p))
-        rd = jnp.where(already, (tv - r1) * (tv - r1)
-                       - (tv - r0) * (tv - r0), 0.0)
+        rd = jnp.where(already, (r0 - r1) * (2.0 * tv - r0 - r1), 0.0)
         refd.append(rd.sum(axis=(1, 2), dtype=jnp.float32))
     stats = (maxidx, jnp.stack(newsig, 1), jnp.stack(sigd, 1),
              jnp.stack(refd, 1))
@@ -184,12 +191,52 @@ class FrontendResult:
         return self.n_tiles * self.layout.n_per_tile
 
 
+@dataclass
+class PendingFrontend:
+    """A dispatched, asynchronously executing frontend batch.
+
+    ``dispatch_frontend`` returns immediately after queueing the device
+    program (JAX dispatch is async); :meth:`resolve` blocks only for the
+    small stats transfer. This is the seam the encoder's overlapped
+    pipeline uses: chunk N+1's device program runs while chunk N's
+    packed payload is Tier-1 coded on host threads."""
+    layout: FrontendLayout
+    n_tiles: int
+    rows: object          # device array, stays in HBM
+    stats: object         # device array tuple (maxidx, newsig, sigd, refd)
+
+    def resolve_stats(self) -> FrontendResult:
+        """Block for the per-block stats (a few KB) and build the
+        FrontendResult. The bitmap rows stay on device."""
+        maxidx, newsig, sigd, refd = jax.device_get(self.stats)
+        n = self.n_tiles * self.layout.n_per_tile
+        nbps = np.zeros(n, dtype=np.int32)
+        nz = maxidx[:n] > 0
+        nbps[nz] = np.floor(np.log2(
+            maxidx[:n][nz].astype(np.float64))).astype(np.int32) + 1
+        # Guard-bit invariant: a magnitude above 2^Mb would make
+        # payload_plan emit row indices into the next block's rows, and
+        # the clamped device gather would corrupt the codestream
+        # *silently*. Fail loudly like the legacy host path — a real
+        # exception, not an assert, so `python -O` can't strip it.
+        caps = np.tile(np.asarray(self.layout.mb_caps, dtype=np.int32),
+                       self.n_tiles)
+        bad = nbps > caps
+        if bad.any():
+            raise ValueError(
+                f"guard-bit violation: block nbps {nbps[bad].max()} "
+                f"exceeds its subband Mb "
+                f"{caps[bad][int(np.argmax(nbps[bad]))]} (coefficient "
+                "overflow in the device front-end)")
+        return FrontendResult(self.layout, self.n_tiles, self.rows, nbps,
+                              newsig[:n], sigd[:n], refd[:n])
+
+
 @contract(shapes={"tiles": [("B", "h", "w"), ("B", "h", "w", "C")]},
           dtypes={"tiles": "number"})
-def run_frontend(plan: TilePlan, tiles: np.ndarray) -> FrontendResult:
-    """Transform + blockify + stats for a (B, h, w[, C]) tile batch.
-
-    Returns stats on host and the packed bitmap rows on device."""
+def dispatch_frontend(plan: TilePlan, tiles: np.ndarray) -> PendingFrontend:
+    """Queue transform + blockify + stats for a (B, h, w[, C]) tile
+    batch on the device and return without waiting for the result."""
     if tiles.ndim == 3:
         tiles = tiles[..., None]
     b = tiles.shape[0]
@@ -199,14 +246,16 @@ def run_frontend(plan: TilePlan, tiles: np.ndarray) -> FrontendResult:
             [tiles, np.zeros((pad,) + tiles.shape[1:], tiles.dtype)])
     layout = layout_for(plan)
     rows, stats = _compiled_frontend(plan, layout.P)(jnp.asarray(tiles))
-    maxidx, newsig, sigd, refd = jax.device_get(stats)
-    n = b * layout.n_per_tile
-    nbps = np.zeros(n, dtype=np.int32)
-    nz = maxidx[:n] > 0
-    nbps[nz] = np.floor(np.log2(maxidx[:n][nz].astype(np.float64))).astype(
-        np.int32) + 1
-    return FrontendResult(layout, b, rows, nbps, newsig[:n], sigd[:n],
-                          refd[:n])
+    return PendingFrontend(layout, b, rows, stats)
+
+
+@contract(shapes={"tiles": [("B", "h", "w"), ("B", "h", "w", "C")]},
+          dtypes={"tiles": "number"})
+def run_frontend(plan: TilePlan, tiles: np.ndarray) -> FrontendResult:
+    """Transform + blockify + stats for a (B, h, w[, C]) tile batch,
+    blocking until the stats are on host (the packed bitmap rows stay on
+    device). Synchronous wrapper over dispatch_frontend/resolve_stats."""
+    return dispatch_frontend(plan, tiles).resolve_stats()
 
 
 @lru_cache(maxsize=8)
@@ -225,6 +274,14 @@ def payload_plan(nbps: np.ndarray, floors: np.ndarray, P: int):
     (src int64 (R,), offsets int64 (n+1,)) — offsets in rows, so block
     b's payload is rows [offsets[b], offsets[b+1])."""
     n = len(nbps)
+    # nbps beyond the packed plane capacity would index into the *next*
+    # block's rows; the device gather clamps out-of-bounds indices, so
+    # the corruption would be silent. Fail loudly (ADVICE round 5 #1) —
+    # a real exception, not an assert, so `python -O` can't strip it.
+    if n and int(nbps.max()) > P:
+        raise ValueError(
+            f"block nbps {int(nbps.max())} exceeds packed plane "
+            f"capacity {P}: guard-bit invariant violated upstream")
     counts = np.where(nbps > floors, nbps - floors + 1, 0).astype(np.int64)
     offsets = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])

@@ -47,6 +47,10 @@ FEATURE_FLAGS = "feature.flags"
 TPU_LOSSY_RATE = "bucketeer.tpu.lossy.rate"          # bpp, kdu '-rate 3' analog
 TPU_BATCH_SIZE = "bucketeer.tpu.batch.size"          # vmap batch for CSV path
 TPU_MESH_SHAPE = "bucketeer.tpu.mesh.shape"          # e.g. "2x4" for v5e-8
+# Images at/above this pixel count route through the device mesh when
+# >1 device is visible (converters/tpu.py); 0/absent keeps the
+# converter's built-in threshold, negative disables mesh routing.
+MESH_MIN_PIXELS = "bucketeer.mesh.min.pixels"
 # Default conversion type when a request doesn't say: "lossless" (the
 # reference hardwires LOSSLESS at ImageWorkerVerticle.java:58-64; here it
 # is a default, not a constant) or "lossy".
@@ -62,7 +66,7 @@ ALL_KEYS = (
     FILESYSTEM_CSV_MOUNT, FILESYSTEM_PREFIX, SLACK_OAUTH_TOKEN,
     SLACK_CHANNEL_ID, SLACK_ERROR_CHANNEL_ID, SLACK_WEBHOOK_URL,
     FEATURE_FLAGS, TPU_LOSSY_RATE, TPU_BATCH_SIZE, TPU_MESH_SHAPE,
-    CONVERSION_TYPE,
+    MESH_MIN_PIXELS, CONVERSION_TYPE,
 )
 
 _DEFAULTS: dict[str, Any] = {

@@ -10,13 +10,31 @@ irreversible 9/7 + ICT with PCRD-opt truncation to 3 bpp (``-rate 3``).
 """
 from __future__ import annotations
 
+import logging
 import os
 
 from ..codec import tiff
 from ..codec.encoder import EncodeParams, encode_jp2
 from .base import Conversion, ConverterError, output_path
 
+LOG = logging.getLogger(__name__)
+
 LOSSY_RATE = 3.0    # reference: -rate 3 (KakaduConverter.java:43)
+
+# Images at or above this pixel count route through the device mesh
+# whenever more than one device is visible: a single giant tile is
+# row-sharded (parallel.sharded_dwt), a tiled image's batches are
+# data-sharded (parallel.batch.run_tiles_sharded). The default is sized
+# so ordinary scans stay on the single-device overlapped pipeline and
+# only archival monsters (BASELINE config 4's 400 MPix maps) pay the
+# mesh dispatch overhead. Override: BUCKETEER_MESH_MIN_PIXELS env or
+# the bucketeer.mesh.min.pixels config key (engine/batch.py).
+DEFAULT_MESH_MIN_PIXELS = 64_000_000
+
+
+def _env_mesh_min_pixels() -> int:
+    return int(os.environ.get("BUCKETEER_MESH_MIN_PIXELS",
+                              str(DEFAULT_MESH_MIN_PIXELS)))
 
 
 class TpuConverter:
@@ -25,9 +43,39 @@ class TpuConverter:
     name = "TPU"
 
     def __init__(self, lossy_rate: float = LOSSY_RATE,
-                 jpx: bool = True) -> None:
+                 jpx: bool = True,
+                 mesh_min_pixels: int | None = None) -> None:
         self.lossy_rate = lossy_rate
         self.jpx = jpx
+        self.mesh_min_pixels = (_env_mesh_min_pixels()
+                                if mesh_min_pixels is None
+                                else mesh_min_pixels)
+
+    def _choose_mesh(self, h: int, w: int, params: EncodeParams):
+        """Mesh routing for over-threshold images: a ('data', 'tile')
+        mesh over all visible devices — all-spatial when the image is a
+        single row-shardable tile, all-data otherwise. None keeps the
+        single-device overlapped pipeline."""
+        if self.mesh_min_pixels <= 0 or h * w < self.mesh_min_pixels:
+            return None
+        import jax
+
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharded_dwt import can_row_shard
+
+        devices = jax.devices()
+        if len(devices) < 2:
+            return None
+        if params.tile_size is None:
+            # A single tile can only parallelize spatially. If its rows
+            # don't shard, a data mesh would pad the batch of one up to
+            # n_devices full-size zero tiles (parallel/batch.py) — all
+            # host memory and dispatch overhead, zero speedup — so stay
+            # on the single-device pipeline instead.
+            if can_row_shard(h, params.levels, len(devices)):
+                return make_mesh(devices, tile_parallel=len(devices))
+            return None
+        return make_mesh(devices, tile_parallel=1)
 
     def convert(self, image_id: str, source_path: str,
                 conversion: Conversion = Conversion.LOSSLESS) -> str:
@@ -51,8 +99,13 @@ class TpuConverter:
         # The base step is calibrated for 8-bit signals; scale it with
         # the signal range so deeper scans quantize proportionally.
         params.base_delta *= (1 << (bitdepth - 8))
+        mesh = self._choose_mesh(h, w, params)
+        if mesh is not None:
+            LOG.info("routing %s (%dx%d) through the device mesh %s",
+                     image_id, w, h, dict(mesh.shape))
         try:
-            data = encode_jp2(img, bitdepth, params, jpx=self.jpx)
+            data = encode_jp2(img, bitdepth, params, jpx=self.jpx,
+                              mesh=mesh)
         except Exception as exc:
             raise ConverterError(
                 f"encode failed for {image_id}: {exc}") from exc

@@ -45,6 +45,17 @@ class BatchConverterWorker:
         self.store = store
         self.bus = bus
         self.config = config
+        # Mesh routing threshold: batch items at/above this pixel count
+        # encode across the device mesh (converters/tpu.py routes a
+        # giant single tile row-sharded, tiled batches data-sharded)
+        # whenever >1 device is visible — the in-process analog of the
+        # reference's large-image peer routing. The config key overrides
+        # the converter's built-in/env default so the fleet is tunable
+        # per deployment.
+        mesh_px = config.get_int(cfg.MESH_MIN_PIXELS, 0)
+        if mesh_px and hasattr(converter, "mesh_min_pixels"):
+            converter.mesh_min_pixels = mesh_px
+            LOG.info("mesh routing threshold set to %d pixels", mesh_px)
 
     def register(self, bus: MessageBus, instances: int = 2) -> None:
         bus.consumer(BATCH_CONVERTER, self.handle, instances=instances)

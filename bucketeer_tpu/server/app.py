@@ -65,7 +65,14 @@ class Api:
 
     def __init__(self, engine: Engine) -> None:
         self.engine = engine
-        self.metrics = metrics_mod.Metrics()
+        # The process-wide registry: the encoder reports its
+        # device-dispatch vs host-coding segments (overlapped pipeline)
+        # and PCRD/Tier-2 retry counters into it, and /metrics serves
+        # it. One shared object, so app re-creation can't strand a
+        # stale sink.
+        self.metrics = metrics_mod.GLOBAL
+        from ..codec import encoder as codec_encoder
+        codec_encoder.set_metrics_sink(self.metrics)
         self._background: set[asyncio.Task] = set()
         # Image-mount path prefix (reference: MainVerticle.java:92-102
         # installs it on the JobFactory at boot).

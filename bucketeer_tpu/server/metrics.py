@@ -6,6 +6,7 @@ stage because throughput is the product metric."""
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -26,9 +27,49 @@ class StageStats:
 
 
 @dataclass
+class OverlapStats:
+    """Paired device/host segments of a pipelined stage. ``saved_s`` is
+    wall time hidden by running the two sides concurrently: with no
+    overlap wall == device + host, so anything above wall was saved."""
+    count: int = 0
+    device_s: float = 0.0
+    host_s: float = 0.0
+    wall_s: float = 0.0
+    pixels: int = 0
+
+    def record(self, device_s: float, host_s: float, wall_s: float,
+               pixels: int = 0) -> None:
+        self.count += 1
+        self.device_s += device_s
+        self.host_s += host_s
+        self.wall_s += wall_s
+        self.pixels += pixels
+
+    @property
+    def saved_s(self) -> float:
+        return max(0.0, self.device_s + self.host_s - self.wall_s)
+
+    @property
+    def overlap_ratio(self) -> float:
+        """Fraction of the shorter side's work hidden behind the longer
+        side (1.0 = the cheaper stage is entirely free)."""
+        shorter = min(self.device_s, self.host_s)
+        return self.saved_s / shorter if shorter > 0 else 0.0
+
+
+@dataclass
 class Metrics:
     stages: dict = field(default_factory=lambda: defaultdict(StageStats))
+    overlaps: dict = field(
+        default_factory=lambda: defaultdict(OverlapStats))
+    counters: dict = field(default_factory=lambda: defaultdict(int))
     started_at: float = field(default_factory=time.time)
+    # Encodes run on real threads (BatchConverterWorker dispatches
+    # converts via asyncio.to_thread, instances=2), and += on the stat
+    # fields is a read-modify-write — serialize updates or rare-event
+    # counters silently lose increments.
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
 
     @contextlib.contextmanager
     def time(self, stage: str, pixels: int = 0):
@@ -36,12 +77,30 @@ class Metrics:
         try:
             yield
         finally:
-            self.stages[stage].record(time.perf_counter() - t0, pixels)
+            self.record(stage, time.perf_counter() - t0, pixels)
 
     def record(self, stage: str, seconds: float, pixels: int = 0) -> None:
-        self.stages[stage].record(seconds, pixels)
+        with self._lock:
+            self.stages[stage].record(seconds, pixels)
+
+    def record_overlap(self, stage: str, device_s: float, host_s: float,
+                       wall_s: float, pixels: int = 0) -> None:
+        """Record one pipelined run's device-dispatch vs host-coding
+        segments (codec/encoder.py overlapped pipeline)."""
+        with self._lock:
+            self.overlaps[stage].record(device_s, host_s, wall_s, pixels)
+
+    def count(self, name: str, n: int = 1) -> None:
+        """Bump an event counter (PCRD floor re-runs, Tier-2 rebuild
+        iterations, mesh routings, ...)."""
+        with self._lock:
+            self.counters[name] += n
 
     def report(self) -> dict:
+        with self._lock:
+            return self._report_locked()
+
+    def _report_locked(self) -> dict:
         out = {"uptime_s": round(time.time() - self.started_at, 1),
                "stages": {}}
         for name, st in sorted(self.stages.items()):
@@ -57,4 +116,24 @@ class Metrics:
                     entry["mpixels_per_s"] = round(
                         st.pixels / 1e6 / st.total_s, 2)
             out["stages"][name] = entry
+        if self.overlaps:
+            out["overlap"] = {}
+            for name, ov in sorted(self.overlaps.items()):
+                out["overlap"][name] = {
+                    "count": ov.count,
+                    "device_s": round(ov.device_s, 3),
+                    "host_s": round(ov.host_s, 3),
+                    "wall_s": round(ov.wall_s, 3),
+                    "saved_s": round(ov.saved_s, 3),
+                    "overlap_ratio": round(ov.overlap_ratio, 4),
+                }
+        if self.counters:
+            out["counters"] = dict(sorted(self.counters.items()))
         return out
+
+
+# Process-wide registry: the encoder reports into one well-known object
+# (codec.encoder.set_metrics_sink) and every Api instance serves the
+# same one, so re-creating the app never strands a stale sink and
+# concurrent Apis don't fight over last-writer-wins.
+GLOBAL = Metrics()

@@ -323,6 +323,23 @@ class TestStatusAndFinalize:
 # ---------- batch dispatch + in-process converter ----------
 
 class TestBatchPath:
+    def test_mesh_threshold_config_applied(self, tmp_path):
+        """bucketeer.mesh.min.pixels flows from config onto the
+        converter so deployments can tune (or disable) mesh routing."""
+        class MeshyConverter(StubConverter):
+            mesh_min_pixels = 64_000_000
+
+        conv = MeshyConverter(tmp_path)
+        config = cfg.Config.load(overrides={cfg.MESH_MIN_PIXELS: "12345"})
+        BatchConverterWorker(conv, JobStore(), MessageBus(), config)
+        assert conv.mesh_min_pixels == 12345
+        # Absent key: converter default untouched.
+        conv2 = MeshyConverter(tmp_path)
+        BatchConverterWorker(conv2, JobStore(), MessageBus(),
+                             cfg.Config.load())
+        assert conv2.mesh_min_pixels == 64_000_000
+
+
     def test_full_batch_lifecycle(self, tmp_path):
         """CSV -> dispatch -> TPU(stub) convert -> S3 -> status -> finalize."""
         job = _batch_fixture(tmp_path, n_items=3)

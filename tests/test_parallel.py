@@ -75,3 +75,120 @@ def test_sharded_tile_batch_lossless(rng, mesh42):
     np.testing.assert_array_equal(
         run_tiles_sharded(plan, tiles, mesh42),
         run_tiles(plan, tiles))
+
+
+# --- mesh-integrated encode (the product path, not just the kernels) ---
+
+def _decode(data):
+    import io
+
+    from PIL import Image
+    return np.asarray(Image.open(io.BytesIO(data)))
+
+
+def test_can_row_shard():
+    from bucketeer_tpu.parallel.sharded_dwt import can_row_shard
+
+    assert can_row_shard(128, 2, 8)         # 16 rows/shard, 4/level-2
+    assert not can_row_shard(128, 2, 1)     # no point with one shard
+    assert not can_row_shard(100, 2, 8)     # not divisible
+    assert not can_row_shard(64, 3, 8)      # 1 row at the coarsest level
+
+
+def test_sharded_transform_tile_matches_run_tiles(rng, mesh8):
+    from bucketeer_tpu.parallel.sharded_dwt import sharded_transform_tile
+
+    plan = make_plan(128, 96, 3, 2, True, 8)
+    tile = rng.integers(0, 256, (128, 96, 3)).astype(np.uint8)
+    got = sharded_transform_tile(plan, tile, mesh8)
+    np.testing.assert_array_equal(got, run_tiles(plan, tile[None])[0])
+
+
+def test_sharded_transform_tile_lossy_matches_run_tiles(rng, mesh8):
+    """The lossy prologue (ICT + 9/7 + fixed-point quantization) mirrors
+    pipeline._transform_batch; if the two copies diverge, the mesh path
+    silently corrupts derivatives. Float summation order across the
+    shard boundary may move a coefficient by at most one quantizer
+    index LSB."""
+    from bucketeer_tpu.parallel.sharded_dwt import sharded_transform_tile
+
+    plan = make_plan(128, 96, 3, 2, False, 8)
+    tile = rng.integers(0, 256, (128, 96, 3)).astype(np.uint8)
+    got = sharded_transform_tile(plan, tile, mesh8).astype(np.int64)
+    ref = run_tiles(plan, tile[None])[0].astype(np.int64)
+    assert np.abs(got - ref).max() <= 1
+    assert (got != ref).mean() < 0.01
+
+
+def test_mesh_encode_spatial_decodable(rng, mesh8):
+    """A single giant tile encodes through sharded_dwt2d_forward (row
+    shards + halo exchange) into a bit-exact, decodable JP2."""
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+
+    img = rng.integers(0, 256, size=(128, 96), dtype=np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(lossless=True,
+                                                   levels=2), mesh=mesh8)
+    np.testing.assert_array_equal(_decode(data), img)
+
+
+def test_mesh_encode_tiled_decodable(rng, mesh42):
+    """A tiled image encodes through run_tiles_sharded (data axis) into
+    a bit-exact, decodable JP2."""
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+
+    img = rng.integers(0, 256, size=(160, 160, 3), dtype=np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(
+        lossless=True, levels=2, tile_size=64), mesh=mesh42)
+    np.testing.assert_array_equal(_decode(data), img)
+
+
+def test_converter_routes_through_mesh(rng, monkeypatch, tmp_path):
+    """The converter path: an over-threshold image on a multi-device
+    host encodes its tile batches through run_tiles_sharded and the
+    derivative decodes bit-exactly (BASELINE config 4's routing seam)."""
+    from PIL import Image
+
+    import bucketeer_tpu.parallel.batch as pbatch
+    from bucketeer_tpu.converters import Conversion, TpuConverter
+
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    img = rng.integers(0, 256, size=(640, 640), dtype=np.uint8)
+    src = tmp_path / "map.tif"
+    Image.fromarray(img).save(src)
+
+    calls = []
+    orig = pbatch.run_tiles_sharded
+
+    def spy(plan, tiles, mesh):
+        calls.append(dict(mesh.shape))
+        return orig(plan, tiles, mesh)
+
+    monkeypatch.setattr(pbatch, "run_tiles_sharded", spy)
+    out = TpuConverter(mesh_min_pixels=1).convert(
+        "map", str(src), Conversion.LOSSLESS)
+    assert calls, "mesh routing did not reach run_tiles_sharded"
+    np.testing.assert_array_equal(np.asarray(Image.open(out)), img)
+
+
+def test_converter_mesh_threshold_respected(rng, monkeypatch, tmp_path):
+    """Below the threshold the converter stays on the single-device
+    pipeline (no mesh dispatch overhead for ordinary scans)."""
+    from PIL import Image
+
+    import bucketeer_tpu.parallel.batch as pbatch
+    from bucketeer_tpu.converters import Conversion, TpuConverter
+
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    img = rng.integers(0, 256, size=(96, 96), dtype=np.uint8)
+    src = tmp_path / "small.tif"
+    Image.fromarray(img).save(src)
+
+    def boom(*a, **k):
+        raise AssertionError("mesh path taken below threshold")
+
+    monkeypatch.setattr(pbatch, "run_tiles_sharded", boom)
+    out = TpuConverter(mesh_min_pixels=10_000_000).convert(
+        "small", str(src), Conversion.LOSSLESS)
+    np.testing.assert_array_equal(np.asarray(Image.open(out)), img)

@@ -1,0 +1,73 @@
+"""codec/tiff.py: the deliberate decompression-bomb policy. A 400 MPix
+archival scan (BASELINE config 4's 20000x20000 maps) must open where
+PIL's default guard rejects it, and our own ceiling must fail loudly
+with an actionable message."""
+import struct
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from bucketeer_tpu.codec import tiff
+
+
+def _huge_tiff(path, w: int, h: int) -> str:
+    """Craft a minimal TIFF header *claiming* w x h pixels (no pixel
+    data — size checks happen at open, before any decode), so the test
+    exercises a genuinely >= 400 MPix image without allocating 400 MB."""
+    entries = [
+        (256, 4, 1, w),          # ImageWidth
+        (257, 4, 1, h),          # ImageLength
+        (258, 3, 1, 8),          # BitsPerSample
+        (259, 3, 1, 1),          # Compression: none
+        (262, 3, 1, 1),          # Photometric: BlackIsZero
+        (273, 4, 1, 8),          # StripOffsets (bogus, never read)
+        (278, 4, 1, h),          # RowsPerStrip
+        (279, 4, 1, w * h),      # StripByteCounts
+    ]
+    ifd = struct.pack("<H", len(entries))
+    for tag, typ, cnt, val in entries:
+        ifd += struct.pack("<HHII", tag, typ, cnt, val)
+    ifd += struct.pack("<I", 0)
+    with open(path, "wb") as fh:
+        fh.write(b"II*\x00" + struct.pack("<I", 8) + ifd)
+    return str(path)
+
+
+def test_400mpix_scan_opens(tmp_path):
+    """20000x20000 = 400 MPix: above PIL's DecompressionBombError
+    threshold, below our archival ceiling."""
+    path = _huge_tiff(tmp_path / "map.tif", 20000, 20000)
+    with pytest.raises(Image.DecompressionBombError):
+        Image.open(path)                 # PIL default would reject it
+    assert tiff.image_size(path) == (20000, 20000)
+
+
+def test_own_ceiling_fails_loudly(tmp_path, monkeypatch):
+    path = _huge_tiff(tmp_path / "map.tif", 20000, 20000)
+    monkeypatch.setenv("BUCKETEER_MAX_IMAGE_PIXELS", "1000000")
+    with pytest.raises(ValueError, match="BUCKETEER_MAX_IMAGE_PIXELS"):
+        tiff.image_size(path)
+    with pytest.raises(ValueError, match="BUCKETEER_MAX_IMAGE_PIXELS"):
+        tiff.read_image(path)
+
+
+def test_pil_guard_restored_after_read(tmp_path, rng):
+    """The global PIL guard is only suspended inside the open bracket."""
+    before = Image.MAX_IMAGE_PIXELS
+    img = rng.integers(0, 256, size=(32, 32), dtype=np.uint8)
+    p = tmp_path / "small.tif"
+    Image.fromarray(img).save(p)
+    arr, depth = tiff.read_image(str(p))
+    np.testing.assert_array_equal(arr, img)
+    assert depth == 8
+    assert Image.MAX_IMAGE_PIXELS == before
+
+
+def test_read_image_normal_formats_still_work(tmp_path, rng):
+    img16 = rng.integers(0, 65536, size=(16, 16)).astype(np.uint16)
+    p = tmp_path / "scan16.tif"
+    Image.fromarray(img16).save(p)
+    arr, depth = tiff.read_image(str(p))
+    assert depth == 16
+    np.testing.assert_array_equal(arr, img16)
