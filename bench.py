@@ -142,6 +142,128 @@ def _timed(fn, repeats: int) -> tuple:
 
 # --- configs -------------------------------------------------------------
 
+def _tier1_split_report(img, params) -> dict:
+    """Host-coding segment, legacy full Tier-1 vs device-CX/D MQ replay
+    (BUCKETEER_DEVICE_CXD): one instrumented encode per mode, reporting
+    the host seconds, the CX/D device segment, symbol throughput and the
+    measured overlap ratio — the numbers ISSUE 3's acceptance gate asks
+    for (host Tier-1 time per chunk down, overlap ratio up)."""
+    import dataclasses
+
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.server.metrics import Metrics
+
+    # Two probes. Serial (the config's own tiling, usually one chunk):
+    # the host segment runs uncontended, so legacy-vs-replay seconds
+    # compare cleanly. Overlap (many single-tile chunks): the ratio the
+    # pipeline actually achieves when host coding hides behind device
+    # compute — on CPU the two sides share cores, which would skew the
+    # serial timing if merged into one probe.
+    from bucketeer_tpu.codec import t1_batch
+
+    out: dict = {}
+    calls: dict = {}
+    for mode, flag in (("legacy", False), ("cxd", True)):
+        calls[mode] = []
+        out[mode] = _tier1_split_one(
+            encoder, Metrics, img,
+            dataclasses.replace(params, device_cxd=flag), flag,
+            capture=calls[mode])
+    # The sink segments above include scheduling noise at smoke sizes;
+    # the speedup number re-times the captured host Tier-1 calls alone
+    # (same inputs the measured encode used), min of 3 — this is "host
+    # Tier-1 time per chunk" with nothing else on the cores.
+    for mode, fn in (("legacy", t1_batch.encode_packed),
+                     ("cxd", t1_batch.encode_cxd)):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for args in calls[mode]:
+                fn(*args)
+            best = min(best, time.perf_counter() - t0)
+        out[mode]["host_tier1_retimed_s"] = round(best, 4)
+    legacy_s = out["legacy"]["host_tier1_retimed_s"]
+    cxd_s = out["cxd"]["host_tier1_retimed_s"]
+    out["host_tier1_speedup"] = (round(legacy_s / cxd_s, 2)
+                                 if cxd_s > 0 else None)
+
+    side = min(128, img.shape[0], img.shape[1])
+    ov_img = img[:side, :side]
+    ov_params = dataclasses.replace(params, tile_size=min(64, side))
+    prev_tiles = os.environ.get("BUCKETEER_OVERLAP_TILES")
+    os.environ["BUCKETEER_OVERLAP_TILES"] = "1"
+    try:
+        out["overlap_probe"] = {
+            mode: _tier1_split_one(
+                encoder, Metrics, ov_img,
+                dataclasses.replace(ov_params, device_cxd=flag),
+                flag)["overlap_ratio"]
+            for mode, flag in (("legacy", False), ("cxd", True))}
+    finally:
+        if prev_tiles is None:
+            os.environ.pop("BUCKETEER_OVERLAP_TILES", None)
+        else:
+            os.environ["BUCKETEER_OVERLAP_TILES"] = prev_tiles
+    return out
+
+
+def _tier1_split_one(encoder, Metrics, img, p, flag,
+                     capture: list | None = None) -> dict:
+    from bucketeer_tpu.codec import t1_batch
+
+    encoder.encode_jp2(img, 8, p)               # warm: exclude compiles
+    sink = Metrics()
+    encoder.set_metrics_sink(sink)
+    orig = (t1_batch.encode_packed, t1_batch.encode_cxd)
+    if capture is not None:
+        # Record the host Tier-1 inputs so the caller can re-time the
+        # host calls in isolation after the encode.
+        def cap_packed(*args):
+            capture.append(args)
+            return orig[0](*args)
+
+        def cap_cxd(streams):
+            capture.append((streams,))
+            return orig[1](streams)
+
+        t1_batch.encode_packed = cap_packed
+        t1_batch.encode_cxd = cap_cxd
+    try:
+        encoder.encode_jp2(img, 8, p)
+    finally:
+        encoder.set_metrics_sink(None)
+        t1_batch.encode_packed, t1_batch.encode_cxd = orig
+    rep = sink.report()
+    st = rep["stages"]
+    ov = rep.get("overlap", {}).get("encode", {})
+    entry = {
+        "host_tier1_s": st["encode.host_code"]["total_s"],
+        "device_s": st["encode.device_dispatch"]["total_s"],
+        "overlap_ratio": ov.get("overlap_ratio", 0.0),
+    }
+    if flag:
+        entry["mq_replay_s"] = st["encode.mq_replay"]["total_s"]
+        entry["cxd_device_s"] = st["encode.cxd_device"]["total_s"]
+        entry["symbols"] = st["encode.mq_replay"].get("items", 0)
+        entry["symbols_per_s"] = st["encode.mq_replay"].get(
+            "items_per_s", 0)
+    return entry
+
+
+def _want_tier1_split() -> bool:
+    """The CX/D comparison runs the jnp scan as the 'device' on CPU —
+    fine at smoke sizes, prohibitive at the full 4096². Auto: smoke or
+    a real accelerator; BENCH_CXD=1/0 forces."""
+    import jax
+
+    from bucketeer_tpu.config import truthy
+
+    env = os.environ.get("BENCH_CXD", "auto")
+    if env != "auto":
+        return truthy(env)
+    return SMOKE or jax.default_backend() != "cpu"
+
+
 def config1_single_4k(repeats: int) -> dict:
     """BASELINE config 1, real recipe: 4096x4096 RGB -> lossy `-rate 3`,
     512 tiles, 6 levels, RPCL, 6 layers, SOP/EPH/PLT."""
@@ -158,13 +280,23 @@ def config1_single_4k(repeats: int) -> dict:
     best, data = _timed(lambda: encoder.encode_jp2(img, 8, params),
                         repeats)
     mpix = size * size / 1e6
-    return {"value": round(mpix / best, 3), "unit": "MPix/s",
-            "seconds": round(best, 3),
-            "image": f"{size}x{size}x3 uint8",
-            "recipe": "kakadu rate=3 tiles=512 levels=6",
-            "output_bytes": len(data),
-            "bpp": round(8.0 * len(data) / (size * size), 3),
-            "repeats": repeats}
+    result = {"value": round(mpix / best, 3), "unit": "MPix/s",
+              "seconds": round(best, 3),
+              "image": f"{size}x{size}x3 uint8",
+              "recipe": "kakadu rate=3 tiles=512 levels=6",
+              "output_bytes": len(data),
+              "bpp": round(8.0 * len(data) / (size * size), 3),
+              "repeats": repeats}
+    if _want_tier1_split():
+        # On CPU, bound the jnp-scan 'device' cost: the host-segment
+        # comparison is per-chunk anyway, so a 256² slab is
+        # representative and keeps smoke CI fast.
+        import jax
+
+        split_img = (img if jax.default_backend() != "cpu"
+                     else img[:min(size, 256), :min(size, 256)])
+        result["tier1_split"] = _tier1_split_report(split_img, params)
+    return result
 
 
 def config2_batch_2k(repeats: int) -> dict:
@@ -310,6 +442,11 @@ CONFIGS = {
 
 
 def main() -> int:
+    from bucketeer_tpu.converters.tpu import (compile_cache_entries,
+                                              maybe_enable_compile_cache)
+
+    cache = maybe_enable_compile_cache()     # BUCKETEER_COMPILE_CACHE
+    entries_before = cache.get("entries", 0)
     backend = init_backend()
     # CPU (dev mode / fallback) is ~500x off the accelerator: keep the
     # default sweep under ~5 minutes there. Explicit env always wins,
@@ -331,6 +468,7 @@ def main() -> int:
         except Exception as exc:                    # keep the scoreboard
             results[name] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    entries_after = compile_cache_entries()
     headline = results.get("1_single_4k_rate3", {})
     value = headline.get("value", 0.0)
     print(json.dumps({
@@ -342,6 +480,14 @@ def main() -> int:
         "n_devices": backend["n_devices"],
         "backend": backend,
         "smoke": SMOKE,
+        "compile_cache": {
+            "enabled": cache["enabled"], "dir": cache["dir"],
+            "entries_before": entries_before,
+            "entries_after": entries_after,
+            # 0 new entries on an enabled cache = every program was a
+            # cache hit; anything else counts the misses persisted.
+            "misses_persisted": max(0, entries_after - entries_before),
+        },
         "configs": results,
     }))
     ok = any("value" in r for r in results.values())

@@ -13,6 +13,11 @@ checker parses both sides and turns drift into a lint failure:
   ``AttributeError`` waiting to happen).
 - ``abi-unbound-export``: the C++ side exports a symbol Python never
   binds (dead export, or a binding someone forgot) — warning severity.
+- ``abi-arity-mismatch``: ``lib.<symbol>.argtypes`` declares a different
+  number of arguments than the C++ definition takes. ctypes would pack
+  the wrong frame silently (extra args dropped, missing args read as
+  garbage), so this is the drift the version integer cannot catch when
+  someone adds a parameter without bumping it.
 """
 from __future__ import annotations
 
@@ -25,6 +30,7 @@ from .findings import ERROR, WARNING, Finding
 VERSION_MISMATCH = "abi-version-mismatch"
 MISSING_EXPORT = "abi-missing-export"
 UNBOUND_EXPORT = "abi-unbound-export"
+ARITY_MISMATCH = "abi-arity-mismatch"
 
 # A C function definition at column 0: return type tokens then the name.
 _CPP_FN_RE = re.compile(r"(?m)^[A-Za-z_][\w]*\s*\*?\s+\*?(\w+)\s*\(")
@@ -33,24 +39,75 @@ _CPP_VERSION_RE = re.compile(
 _CPP_KEYWORDS = {"if", "for", "while", "switch", "return", "sizeof"}
 
 
+def _cpp_arity(block: str, open_paren: int) -> int | None:
+    """Parameter count of the definition whose '(' is at ``open_paren``
+    (handles multi-line parameter lists; None if unbalanced)."""
+    depth = 0
+    params = 0
+    for i in range(open_paren, len(block)):
+        ch = block[i]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                inner = block[open_paren + 1:i].strip()
+                if not inner or inner == "void":
+                    return 0
+                return params + 1
+        elif ch == "," and depth == 1:
+            params += 1
+    return None
+
+
 def parse_cpp_exports(cpp_text: str):
-    """(exported function names, abi version int or None)."""
+    """(exported function names, abi version int or None,
+    {name: parameter count})."""
     start = cpp_text.find('extern "C"')
     block = cpp_text[start:] if start >= 0 else ""
-    names = {m.group(1) for m in _CPP_FN_RE.finditer(block)}
-    names -= _CPP_KEYWORDS
+    names = set()
+    arities = {}
+    for m in _CPP_FN_RE.finditer(block):
+        name = m.group(1)
+        if name in _CPP_KEYWORDS:
+            continue
+        names.add(name)
+        arity = _cpp_arity(block, m.end() - 1)
+        if arity is not None:
+            arities[name] = arity
     m = _CPP_VERSION_RE.search(cpp_text)
     version = int(m.group(1)) if m else None
-    return names, version
+    return names, version, arities
+
+
+def _static_list_len(node: ast.expr) -> int | None:
+    """Statically evaluate the length of a ctypes argtypes expression:
+    list literals, ``list + list`` and ``list * k`` (the binding style
+    native/__init__.py uses). None when the shape isn't static."""
+    if isinstance(node, ast.List):
+        return len(node.elts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _static_list_len(node.left)
+        right = _static_list_len(node.right)
+        return None if left is None or right is None else left + right
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        seq, k = node.left, node.right
+        if isinstance(seq, ast.Constant):
+            seq, k = k, seq
+        if isinstance(k, ast.Constant) and isinstance(k.value, int):
+            n = _static_list_len(seq)
+            return None if n is None else n * k.value
+    return None
 
 
 def parse_python_bindings(py_text: str, filename: str = "<native>"):
     """(_ABI_VERSION int or None, {symbols configured on ``lib``},
-    line of the version assignment)."""
+    line of the version assignment, {symbol: declared argtypes arity})."""
     tree = ast.parse(py_text, filename=filename)
     version = None
     version_line = 1
     symbols: dict = {}        # name -> first line used
+    arities: dict = {}        # name -> len(argtypes) when static
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for target in node.targets:
@@ -60,11 +117,19 @@ def parse_python_bindings(py_text: str, filename: str = "<native>"):
                         isinstance(node.value.value, int):
                     version = node.value.value
                     version_line = node.lineno
+                if isinstance(target, ast.Attribute) and \
+                        target.attr == "argtypes" and \
+                        isinstance(target.value, ast.Attribute) and \
+                        isinstance(target.value.value, ast.Name) and \
+                        target.value.value.id == "lib":
+                    n = _static_list_len(node.value)
+                    if n is not None:
+                        arities[target.value.attr] = (n, node.lineno)
         if isinstance(node, ast.Attribute) and \
                 isinstance(node.value, ast.Name) and \
                 node.value.id == "lib":
             symbols.setdefault(node.attr, node.lineno)
-    return version, symbols, version_line
+    return version, symbols, version_line, arities
 
 
 def check_native(native_dir: Path, rel_to: Path | None = None) -> list:
@@ -84,9 +149,10 @@ def check_native(native_dir: Path, rel_to: Path | None = None) -> list:
         return str(p)
 
     try:
-        py_version, symbols, version_line = parse_python_bindings(
-            init.read_text(encoding="utf-8"), str(init))
-        exports, cpp_version = parse_cpp_exports(
+        py_version, symbols, version_line, py_arities = \
+            parse_python_bindings(init.read_text(encoding="utf-8"),
+                                  str(init))
+        exports, cpp_version, cpp_arities = parse_cpp_exports(
             cpp.read_text(encoding="utf-8"))
     except (OSError, SyntaxError) as exc:
         return [Finding("parse-error", rel(init), 1,
@@ -112,4 +178,13 @@ def check_native(native_dir: Path, rel_to: Path | None = None) -> list:
             UNBOUND_EXPORT, rel(cpp), 1,
             f"t1.cpp exports {sym}() but the ctypes loader never binds "
             "it", WARNING, sym))
+    for sym, (n_py, line) in sorted(py_arities.items()):
+        n_cpp = cpp_arities.get(sym)
+        if n_cpp is not None and n_py != n_cpp:
+            findings.append(Finding(
+                ARITY_MISMATCH, rel(init), line,
+                f"lib.{sym}.argtypes declares {n_py} argument(s) but "
+                f"the C++ definition takes {n_cpp}; ctypes would pack "
+                "the wrong call frame", ERROR,
+                f"lib.{sym}.argtypes"))
     return findings

@@ -53,12 +53,15 @@ SYNC_METHODS = {"item", "tolist", "block_until_ready",
                 "copy_to_host_async"}
 
 # Functions allowed to call jax.device_get in the codec/parallel layers:
-# the sanctioned compaction gather, the host batch-entry wrappers, the
-# async-dispatch stats resolver (PendingFrontend.resolve_stats — a few
-# KB of per-block stats), and the mesh single-tile transform exit.
-D2H_SANCTIONED = {"fetch_payload", "run_frontend", "run_tiles",
-                  "run_tiles_sharded", "resolve_stats",
-                  "sharded_transform_tile"}
+# the sanctioned compaction gather (frontend.gather_rows, shared by the
+# packed-bitmap fetch_payload and the CX/D symbol fetch), the host
+# batch-entry wrappers, the async-dispatch stats resolver
+# (PendingFrontend.resolve_stats — a few KB of per-block stats), the
+# CX/D stream assembly (cxd.run_cxd — pass tables + row-granular symbol
+# payload), and the mesh single-tile transform exit.
+D2H_SANCTIONED = {"fetch_payload", "gather_rows", "run_frontend",
+                  "run_tiles", "run_tiles_sharded", "resolve_stats",
+                  "run_cxd", "sharded_transform_tile"}
 D2H_SCOPES = ("codec", "parallel")
 
 

@@ -1,0 +1,511 @@
+"""Device-side EBCOT context modeling: CX/D symbol streams on the TPU.
+
+The host Tier-1 coder (native/t1.cpp) used to redo the full Annex D
+context modeling — significance propagation / magnitude refinement /
+cleanup, with live neighborhood state — for every bit-plane of every
+code-block. Everything in that loop except the MQ state machine is
+data-parallel across code-blocks, so this stage moves it onto the
+device: a vmapped scan over each block's stripe columns emits, per
+block, the exact ordered (context, decision) symbol sequence the MQ
+coder consumes, packed 6 bits/symbol, plus per-pass symbol counts (the
+pass boundaries PCRD truncation needs) and per-pass distortion sums.
+The host side shrinks to ``t1_encode_cxd`` (native/t1.cpp): replay the
+precomputed symbols through the MQ coder — no neighborhood state, no
+bit-plane walks.
+
+Two device implementations share one step function (`_make_step`):
+
+- the jnp path (`lax.scan` over stripe-column steps, vmapped across
+  blocks) — runs on every backend and is the CPU/test reference;
+- the Pallas TPU kernel (codec/pallas/cxd_scan.py) — same step inside a
+  ``pallas_call`` with one block per grid cell, gated by
+  ``BUCKETEER_CXD_PALLAS`` (default: TPU backend only).
+
+Byte parity is the contract: the symbol sequence equals the one
+codec/t1.py's reference coder feeds its MQEncoder (tests/test_cxd.py
+proves this with a recording coder), so replaying it yields
+byte-identical block streams and identical truncation lengths.
+
+Distortion exactness: PCRD byte-parity with the legacy packed path also
+requires bit-identical per-pass distortion values. The native packed
+coder accumulates integer-valued midpoint terms in float64; float64 is
+unavailable on device, so the scan accumulates ``4 x dist`` (always an
+integer) as an unevaluated double-float pair — Dekker two-product /
+Knuth two-sum — which represents integer sums exactly to ~2^48. The
+host reconstitutes ``(hi + lo) / 4`` in float64 and lands on the same
+number the native coder would have produced.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..analysis import retrace
+from ..config import truthy as cfg_truthy
+from .mq import CTX_RL, CTX_UNIFORM, MQEncoder
+from .t1 import _SC, _ZC_HH, _ZC_LL_LH, BAND_CLS
+
+CBLK = 64
+STRIPES = CBLK // 4
+COLS_PER_PLANE = STRIPES * CBLK          # stripe-column steps per pass
+SYMS_PER_ROW = 512                       # fetch granularity (symbols)
+PACKED_ROW_BYTES = SYMS_PER_ROW * 3 // 4  # 6 bits/symbol -> 384 bytes
+
+
+def _zc_stack() -> np.ndarray:
+    hl = np.transpose(_ZC_LL_LH, (1, 0, 2))
+    return np.stack([_ZC_LL_LH, _ZC_HH, hl]).astype(np.int32)
+
+
+def _sc_tables():
+    ctx = np.zeros((3, 3), dtype=np.int32)
+    xor = np.zeros((3, 3), dtype=np.int32)
+    for (h, v), (c, x) in _SC.items():
+        ctx[h + 1, v + 1] = c
+        xor[h + 1, v + 1] = x
+    return ctx, xor
+
+
+def max_syms(P: int) -> int:
+    """Static per-block symbol capacity: per plane, every sample emits at
+    most one decision, a run-length shortcut adds at most 2 symbols per
+    stripe column, and each sample emits its sign exactly once ever."""
+    return P * (CBLK * CBLK + 2 * COLS_PER_PLANE) + CBLK * CBLK
+
+
+def rows_per_block(P: int) -> int:
+    return max_syms(P) // SYMS_PER_ROW
+
+
+# --- exact double-float accumulation (see module docstring) -------------
+
+def _two_sum(a, b):
+    s = a + b
+    bb = s - a
+    return s, (a - (s - bb)) + (b - bb)
+
+
+_SPLIT = np.float32(4097.0)      # 2^12 + 1 (Veltkamp)
+
+
+def _two_prod(a, b):
+    p = a * b
+    aa = _SPLIT * a
+    ahi = aa - (aa - a)
+    alo = a - ahi
+    bb = _SPLIT * b
+    bhi = bb - (bb - b)
+    blo = b - bhi
+    err = ((ahi * bhi - p) + ahi * blo + alo * bhi) + alo * blo
+    return p, err
+
+
+def _dd_accumulate(dh, dl, p, t, cond, fa, fb):
+    """dh/dl[p, t] += fa * fb (exactly, masked by ``cond``)."""
+    a = jnp.where(cond, fa, jnp.float32(0.0))
+    b = jnp.where(cond, fb, jnp.float32(0.0))
+    ph, pe = _two_prod(a, b)
+    sh, se = _two_sum(dh[p, t], ph)
+    te = dl[p, t] + pe + se
+    nh, nl = _two_sum(sh, te)
+    return dh.at[p, t].set(nh), dl.at[p, t].set(nl)
+
+
+def _d4_sig(v, p):
+    """4 x significance distortion (t1.sig_dist with tv = v) as two exact
+    int-valued float32 factors: D4 = A * (4v - A), A = 2*(vb + 2^(p-1))."""
+    a = ((v >> p) << (p + 1)) + (1 << p)
+    return a.astype(jnp.float32), (4 * v - a).astype(jnp.float32)
+
+
+def _d4_ref(v, p):
+    """4 x refinement distortion (t1.ref_dist with tv = v):
+    D4 = (C - B) * (4v - B - C) with B = 2*r1, C = 2*r0."""
+    b = ((v >> (p + 1)) << (p + 2)) + (1 << (p + 1))
+    c = ((v >> p) << (p + 1)) + (1 << p)
+    return (c - b).astype(jnp.float32), (4 * v - b - c).astype(jnp.float32)
+
+
+# --- the shared stripe-column step --------------------------------------
+
+def _make_step(P: int, idx, neg, nbp, floor, cls, h, w, tables=None):
+    """Build the scan step for one block.
+
+    ``idx``/``neg``: (64, 64) int32 magnitude indices and sign bits;
+    ``nbp``/``floor``/``cls``/``h``/``w``: scalars. The returned
+    ``step(carry, xt)`` processes one stripe column of one pass
+    (xt = [plane, pass, y0, x]) and is shared verbatim between the
+    vmapped lax.scan path and the Pallas kernel (pallas/cxd_scan.py).
+    ``tables``: optional (zc (3,3,3,5), sc_ctx (3,3), sc_xor (3,3))
+    int32 arrays — the Pallas kernel passes them as kernel inputs
+    (kernels cannot capture array constants); None embeds them.
+
+    Carry: (chi (66,66) int32 zero-padded sign/significance state,
+    pi (64,64) int32, refined (64,64) int32, cursor int32,
+    buf (max_syms,) uint8, counts (P,3) int32 cursor-at-end-of-pass,
+    dh/dl (P,3) float32 double-float 4x-distortion sums).
+    """
+    if tables is None:
+        sc_c, sc_x = _sc_tables()
+        tables = (jnp.asarray(_zc_stack()), jnp.asarray(sc_c),
+                  jnp.asarray(sc_x))
+    zc, sc_ctx, sc_xor = tables
+    msym = max_syms(P)
+
+    def emit(buf, cur, cond, ctx, d):
+        sym = (ctx | (d << 5)).astype(jnp.uint8)
+        buf = buf.at[jnp.where(cond, cur, msym)].set(sym, mode="drop")
+        return buf, cur + cond.astype(jnp.int32)
+
+    def step(carry, xt):
+        chi, pi, ref, cur, buf, counts, dh, dl = carry
+        p, t, y0, x = xt[0], xt[1], xt[2], xt[3]
+
+        valid = (p < nbp) & (p >= floor)
+        first = p == nbp - 1
+        col_live = valid & ((t == 2) | jnp.logical_not(first)) \
+            & (x < w) & (y0 < h)
+
+        # One dynamic slice covers the whole stripe column plus its halo
+        # in padded coordinates: sample (y, x) lives at patch[y-y0+1, 1].
+        patch = lax.dynamic_slice(chi, (y0, x), (6, 3))
+        pi_c = lax.dynamic_slice(pi, (y0, x), (4, 1))[:, 0]
+        ref_c = lax.dynamic_slice(ref, (y0, x), (4, 1))[:, 0]
+        v4 = lax.dynamic_slice(idx, (y0, x), (4, 1))[:, 0]
+        n4 = lax.dynamic_slice(neg, (y0, x), (4, 1))[:, 0]
+        bit4 = (v4 >> p) & 1
+
+        def nbr_sums(sigm, i):
+            sh = sigm[i + 1, 0] + sigm[i + 1, 2]
+            sv = sigm[i, 1] + sigm[i + 2, 1]
+            sd = (sigm[i, 0] + sigm[i, 2]
+                  + sigm[i + 2, 0] + sigm[i + 2, 2])
+            return sh, sv, sd
+
+        def sign_emit(buf, cur, cond, patch, i, neg_i):
+            hc = jnp.clip(patch[i + 1, 0] + patch[i + 1, 2], -1, 1)
+            vc = jnp.clip(patch[i, 1] + patch[i + 2, 1], -1, 1)
+            return emit(buf, cur, cond, sc_ctx[hc + 1, vc + 1],
+                        neg_i ^ sc_xor[hc + 1, vc + 1])
+
+        # Run-length shortcut (cleanup only): the whole stripe must be in
+        # extent, uncoded, insignificant, with empty neighborhoods — all
+        # judged on column-start state, exactly like the reference.
+        sig0 = (patch != 0).astype(jnp.int32)
+        empty = col_live & (t == 2) & ((y0 + 3) < h)
+        for i in range(4):
+            sh, sv, sd = nbr_sums(sig0, i)
+            empty = empty & (sig0[i + 1, 1] == 0) & (pi_c[i] == 0) \
+                & ((sh + sv + sd) == 0)
+        rl_ok = empty
+        any_run = bit4.max() > 0
+        k = jnp.argmax(bit4).astype(jnp.int32)
+        rl1 = rl_ok & any_run
+
+        buf, cur = emit(buf, cur, rl_ok, jnp.int32(CTX_RL),
+                        any_run.astype(jnp.int32))
+        buf, cur = emit(buf, cur, rl1, jnp.int32(CTX_UNIFORM), (k >> 1) & 1)
+        buf, cur = emit(buf, cur, rl1, jnp.int32(CTX_UNIFORM), k & 1)
+        # Sample k becomes significant with no ZC decision: set state,
+        # accumulate its distortion, code its sign.
+        patch = patch.at[k + 1, 1].set(
+            jnp.where(rl1, 1 - 2 * n4[k], patch[k + 1, 1]))
+        fa, fb = _d4_sig(v4[k], p)
+        dh, dl = _dd_accumulate(dh, dl, p, t, rl1, fa, fb)
+        buf, cur = sign_emit(buf, cur, rl1, patch, k, n4[k])
+
+        for i in range(4):
+            samp_in = col_live & ((y0 + i) < h)
+            sigm = (patch != 0).astype(jnp.int32)
+            sig_i = sigm[i + 1, 1] != 0
+            pi_i = pi_c[i] != 0
+            sh, sv, sd = nbr_sums(sigm, i)
+            nz = (sh + sv + sd) > 0
+            sp = samp_in & (t == 0) & ~sig_i & nz
+            mr = samp_in & (t == 1) & sig_i & ~pi_i
+            rl_skip = rl_ok & (jnp.logical_not(any_run) | (i <= k))
+            cl = samp_in & (t == 2) & ~sig_i & ~pi_i & ~rl_skip
+            ctx = jnp.where(t == 1,
+                            jnp.where(ref_c[i] != 0, 16,
+                                      jnp.where(nz, 15, 14)),
+                            zc[cls, sh, sv, sd])
+            buf, cur = emit(buf, cur, sp | mr | cl, ctx, bit4[i])
+            newsig = (sp | cl) & (bit4[i] == 1)
+            pi_c = pi_c.at[i].set(jnp.where(sp, 1, pi_c[i]))
+            ref_c = ref_c.at[i].set(jnp.where(mr, 1, ref_c[i]))
+            patch = patch.at[i + 1, 1].set(
+                jnp.where(newsig, 1 - 2 * n4[i], patch[i + 1, 1]))
+            fa, fb = _d4_sig(v4[i], p)
+            dh, dl = _dd_accumulate(dh, dl, p, t, newsig, fa, fb)
+            fa, fb = _d4_ref(v4[i], p)
+            dh, dl = _dd_accumulate(dh, dl, p, t, mr, fa, fb)
+            buf, cur = sign_emit(buf, cur, newsig, patch, i, n4[i])
+
+        chi = lax.dynamic_update_slice(chi, patch[1:5, 1:2],
+                                       (y0 + 1, x + 1))
+        pi = lax.dynamic_update_slice(pi, pi_c[:, None], (y0, x))
+        ref = lax.dynamic_update_slice(ref, ref_c[:, None], (y0, x))
+        counts = counts.at[p, t].set(cur)
+        # The coded-this-plane flags reset after every cleanup pass.
+        plane_done = (t == 2) & (y0 == CBLK - 4) & (x == CBLK - 1)
+        pi = jnp.where(plane_done, jnp.zeros_like(pi), pi)
+        return (chi, pi, ref, cur, buf, counts, dh, dl), None
+
+    return step
+
+
+def init_state(P: int):
+    msym = max_syms(P)
+    return (jnp.zeros((CBLK + 2, CBLK + 2), jnp.int32),
+            jnp.zeros((CBLK, CBLK), jnp.int32),
+            jnp.zeros((CBLK, CBLK), jnp.int32),
+            jnp.int32(0),
+            jnp.zeros((msym,), jnp.uint8),
+            jnp.zeros((P, 3), jnp.int32),
+            jnp.zeros((P, 3), jnp.float32),
+            jnp.zeros((P, 3), jnp.float32))
+
+
+def scan_xs(P: int) -> np.ndarray:
+    """(T, 4) int32 [plane, pass, stripe_y0, column] in coding order:
+    planes descending, passes sigprop/magref/cleanup, stripes then
+    columns — first-plane and sub-floor steps are masked in the kernel,
+    not skipped, so the shape stays static."""
+    steps = []
+    for p in range(P - 1, -1, -1):
+        for t in range(3):
+            for y0 in range(0, CBLK, 4):
+                for x in range(CBLK):
+                    steps.append((p, t, y0, x))
+    return np.asarray(steps, dtype=np.int32)
+
+
+def _cxd_single(P, frac_bits, xs, coeffs, nbp, floor, cls, h, w):
+    idx = (jnp.abs(coeffs) >> frac_bits).astype(jnp.int32)
+    # Bits below the floor are truncated away exactly as the packed
+    # payload never ships them: the host coder's distortion estimates
+    # are computed from the floored magnitudes, and byte-parity of the
+    # PCRD decisions requires reproducing that — not the full-precision
+    # values (t1.encode_block's "the caller must have zeroed the
+    # corresponding magnitude bits" contract).
+    idx = (idx >> floor) << floor
+    neg = (coeffs < 0).astype(jnp.int32)
+    step = _make_step(P, idx, neg, nbp, floor, cls, h, w)
+    carry, _ = lax.scan(step, init_state(P), xs)
+    _, _, _, cur, buf, counts, dh, dl = carry
+    return buf, counts, dh, dl, cur
+
+
+def pack6(buf: jnp.ndarray) -> jnp.ndarray:
+    """(N, max_syms) uint8 symbols -> (N, max_syms*3/4) uint8, four 6-bit
+    symbols per little-endian 24-bit group."""
+    n, m = buf.shape
+    q = buf.reshape(n, m // 4, 4).astype(jnp.int32)
+    word = q[..., 0] | (q[..., 1] << 6) | (q[..., 2] << 12) | (q[..., 3] << 18)
+    out = jnp.stack([word & 0xFF, (word >> 8) & 0xFF, (word >> 16) & 0xFF],
+                    axis=-1)
+    return out.astype(jnp.uint8).reshape(n, m * 3 // 4)
+
+
+def unpack6(packed: np.ndarray, n_syms: int) -> np.ndarray:
+    """Host-side inverse of :func:`pack6` for one block's byte region."""
+    groups = np.frombuffer(packed.tobytes(), dtype=np.uint8)
+    groups = groups[:-(len(groups) % 3) or None].reshape(-1, 3).astype(
+        np.int32)
+    word = groups[:, 0] | (groups[:, 1] << 8) | (groups[:, 2] << 16)
+    syms = np.stack([(word >> (6 * r)) & 63 for r in range(4)],
+                    axis=1).reshape(-1)
+    return syms[:n_syms].astype(np.uint8)
+
+
+def _use_pallas() -> bool:
+    env = os.environ.get("BUCKETEER_CXD_PALLAS", "auto")
+    if env == "auto":
+        return jax.default_backend() == "tpu"
+    return cfg_truthy(env)
+
+
+def _cxd_body(impl, blocks, nbps, floors, cls, hs, ws):
+    buf, counts, dh, dl, cur = impl(blocks, nbps, floors, cls, hs, ws)
+    packed = pack6(buf).reshape(-1, PACKED_ROW_BYTES)
+    return packed, counts, dh, dl, cur
+
+
+@lru_cache(maxsize=64)
+def _compiled_cxd(P: int, frac_bits: int):
+    """One jitted CX/D program per (plane count, fixed-point shift).
+    The Pallas-vs-jnp choice is made here, outside the traced body
+    (cached with the program — flip BUCKETEER_CXD_PALLAS before first
+    use)."""
+    if _use_pallas():
+        from .pallas.cxd_scan import cxd_pallas
+        impl = partial(cxd_pallas, P, frac_bits)
+    else:
+        impl = jax.vmap(partial(_cxd_single, P, frac_bits,
+                                jnp.asarray(scan_xs(P))))
+    return jax.jit(retrace.instrument("cxd", partial(_cxd_body, impl)))
+
+
+# --- host-side result assembly ------------------------------------------
+
+@dataclass
+class CxdStreams:
+    """One chunk's CX/D payload, host-side: packed symbol rows plus the
+    ordered pass tables the MQ replay walks."""
+    payload: np.ndarray        # (R, 384) uint8 packed symbol rows
+    row_offsets: np.ndarray    # (n,) int64 first payload row per block
+    nbps: np.ndarray           # (n,) int32
+    pass_offsets: np.ndarray   # (n+1,) int64 into the pass arrays
+    pass_types: np.ndarray     # int32 0=sigprop 1=magref 2=cleanup
+    pass_planes: np.ndarray    # int32
+    pass_nsyms: np.ndarray     # int32 symbols in this pass
+    pass_dists: np.ndarray     # float64 exact distortion reduction
+    total_syms: int
+
+
+def pass_tables(nbps: np.ndarray, floors: np.ndarray, counts: np.ndarray,
+                dh: np.ndarray, dl: np.ndarray):
+    """Per-block ordered pass lists from the device's cursor snapshots.
+
+    ``counts[b, p, t]`` is the symbol cursor after pass (p, t); walking
+    passes in coding order and differencing recovers per-pass symbol
+    counts. Returns (pass_offsets (n+1,) int64, types, planes, nsyms
+    int32 arrays, dists float64, totals (n,) int64).
+    """
+    n = len(nbps)
+    types, planes, nsyms, dists = [], [], [], []
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    totals = np.zeros(n, dtype=np.int64)
+    dist = (dh.astype(np.float64) + dl.astype(np.float64)) / 4.0
+    for b in range(n):
+        prev = 0
+        nbp, flo = int(nbps[b]), int(floors[b])
+        for p in range(nbp - 1, flo - 1, -1):
+            for t in ((2,) if p == nbp - 1 else (0, 1, 2)):
+                c = int(counts[b, p, t])
+                types.append(t)
+                planes.append(p)
+                nsyms.append(c - prev)
+                dists.append(dist[b, p, t])
+                prev = c
+        totals[b] = prev
+        offsets[b + 1] = len(types)
+    return (offsets, np.asarray(types, np.int32),
+            np.asarray(planes, np.int32), np.asarray(nsyms, np.int32),
+            np.asarray(dists, np.float64), totals)
+
+
+def replay_block(syms: np.ndarray, nbp: int, n_passes: int,
+                 pass_types, pass_planes, pass_nsyms, pass_dists):
+    """Pure-Python MQ replay of one block's symbol stream — the
+    no-native fallback and the test reference. Returns t1.CodedBlock."""
+    from . import t1
+
+    mq = MQEncoder()
+    passes = []
+    pos = 0
+    for j in range(n_passes):
+        for s in syms[pos:pos + int(pass_nsyms[j])]:
+            mq.encode(int(s) >> 5, int(s) & 31)
+        pos += int(pass_nsyms[j])
+        passes.append(t1.PassInfo(int(pass_types[j]), int(pass_planes[j]),
+                                  mq.truncation_length(),
+                                  float(pass_dists[j])))
+    data = mq.flush() if n_passes else b""
+    for info in passes:
+        info.cum_length = min(info.cum_length, len(data))
+    return t1.CodedBlock(data, nbp if n_passes else 0, passes)
+
+
+class RecordingMQEncoder(MQEncoder):
+    """MQEncoder that also records the (context, decision) sequence and
+    the symbol count at every truncation point — the ground truth the
+    device CX/D streams are tested against (tests/test_cxd.py)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.symbols: list = []
+        self.boundaries: list = []
+
+    def encode(self, bit: int, ctx: int) -> None:
+        self.symbols.append(ctx | (bit << 5))
+        super().encode(bit, ctx)
+
+    def truncation_length(self) -> int:
+        self.boundaries.append(len(self.symbols))
+        return super().truncation_length()
+
+
+def reference_cxd(mags: np.ndarray, signs: np.ndarray, band: str,
+                  floor: int = 0):
+    """Reference CX/D stream via codec/t1.py with a recording coder.
+    Returns (CodedBlock, symbols uint8 array, pass boundary list)."""
+    from . import t1
+
+    rec = RecordingMQEncoder()
+    blk = t1.encode_block(mags, signs, band, floor=floor, mq=rec)
+    return blk, np.asarray(rec.symbols, dtype=np.uint8), rec.boundaries
+
+
+def run_cxd(blocks_dev, nbps: np.ndarray, floors: np.ndarray,
+            bandnames: list, hs: np.ndarray, ws: np.ndarray,
+            P: int, frac_bits: int) -> CxdStreams:
+    """Run the device CX/D program for one chunk and fetch its streams.
+
+    ``blocks_dev``: (N, 64, 64) int32 device array (N >= n real blocks;
+    the tail is batch padding). Only the packed symbol rows each live
+    block actually filled travel device->host (row-granular gather, like
+    frontend.fetch_payload).
+    """
+    from . import frontend
+
+    n = len(nbps)
+    # The scan length and symbol capacity scale with the plane count;
+    # planes above every block's MSB emit nothing, so clamp to the
+    # chunk's realized maximum (bounded variants: one compile per
+    # distinct effective P, at most layout.P of them).
+    P = max(1, min(P, int(nbps.max()) if n else 1))
+    N = int(blocks_dev.shape[0])
+    nbps_d = np.zeros(N, np.int32)
+    nbps_d[:n] = nbps
+    floors_d = np.full(N, P, np.int32)     # padding: floor >= nbp -> dead
+    floors_d[:n] = floors
+    cls = np.zeros(N, np.int32)
+    cls[:n] = [BAND_CLS[b] for b in bandnames]
+    hs_d = np.full(N, CBLK, np.int32)
+    hs_d[:n] = hs
+    ws_d = np.full(N, CBLK, np.int32)
+    ws_d[:n] = ws
+
+    packed, counts, dh, dl, cur = _compiled_cxd(P, frac_bits)(
+        blocks_dev, jnp.asarray(nbps_d), jnp.asarray(floors_d),
+        jnp.asarray(cls), jnp.asarray(hs_d), jnp.asarray(ws_d))
+
+    counts, dh, dl = (np.asarray(jax.device_get(a))[:n]
+                      for a in (counts, dh, dl))
+    offsets, types, planes, nsyms, dists, totals = pass_tables(
+        nbps, floors, counts, dh, dl)
+    if totals.size and int(totals.max()) > max_syms(P):
+        raise ValueError(
+            f"CX/D stream overflow: {int(totals.max())} symbols exceed "
+            f"the static capacity {max_syms(P)} (P={P})")
+
+    rpb = rows_per_block(P)
+    rows_needed = -(-totals // SYMS_PER_ROW)
+    row_offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(rows_needed, out=row_offsets[1:])
+    src = np.empty(int(row_offsets[-1]), dtype=np.int64)
+    for b in np.nonzero(rows_needed)[0]:
+        o = row_offsets[b]
+        src[o:row_offsets[b + 1]] = (b * rpb
+                                     + np.arange(rows_needed[b]))
+    payload = frontend.gather_rows(packed, src, PACKED_ROW_BYTES)
+    return CxdStreams(payload, row_offsets[:-1], nbps.astype(np.int32),
+                      offsets, types, planes, nsyms, dists,
+                      int(totals.sum()))

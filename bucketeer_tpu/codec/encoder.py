@@ -36,14 +36,16 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..analysis.contracts import contract
+from ..config import truthy as cfg_truthy
 from . import codestream as cs
+from . import cxd as cxd_mod
 from . import frontend
 from . import jp2 as jp2box
 from . import rate as rate_mod
 from . import t1, t1_batch, t2
 from .dwt import synthesis_gains
 from .pipeline import TilePlan, make_plan
-from .quant import GUARD_BITS, SubbandQuant
+from .quant import FRAC_BITS, GUARD_BITS, SubbandQuant
 
 CBLK_EXP = 6  # 64x64 code-blocks (reference recipe Cblk={64,64})
 
@@ -63,6 +65,15 @@ def _overlap_tiles() -> int:
     """Tiles per pipeline chunk. Power-of-two keeps the batch bucketing
     (pipeline._bucket) from compiling extra program variants."""
     return max(1, int(os.environ.get("BUCKETEER_OVERLAP_TILES", "8")))
+
+
+def _device_cxd(params: EncodeParams) -> bool:
+    """Whether this encode runs the device-CX/D Tier-1 split: the
+    explicit EncodeParams.device_cxd wins, else BUCKETEER_DEVICE_CXD
+    (config.truthy spellings)."""
+    if params.device_cxd is not None:
+        return bool(params.device_cxd)
+    return cfg_truthy(os.environ.get("BUCKETEER_DEVICE_CXD"))
 
 
 # Optional per-stage timing/counter sink (server.metrics.Metrics). The
@@ -95,6 +106,12 @@ class EncodeParams:
     tparts_r: bool = False             # tile-part per resolution (ORGtparts=R)
     mct: str = "auto"                  # multi-component transform: auto|on|off
     comment: str = "bucketeer-tpu jp2 encoder"
+    # Tier-1 split: run EBCOT context modeling on the device and replay
+    # the CX/D streams through the host MQ coder (codec/cxd.py +
+    # native t1_encode_cxd). None = the BUCKETEER_DEVICE_CXD env flag
+    # decides; the converter wires the bucketeer.tpu.device.cxd config
+    # key through here. Byte-identical output either way.
+    device_cxd: bool | None = None
 
     @classmethod
     def kakadu_recipe(cls, lossless: bool,
@@ -762,7 +779,11 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     chunks, tile_records, qcd_values = _build_chunks(
         groups, plans, used_mct, gains, weight_of_slot, norms)
 
-    tm = {"device": 0.0, "host": 0.0}
+    use_cxd = _device_cxd(params)
+    frac_bits = 0 if params.lossless else FRAC_BITS
+    tm = {"device": 0.0, "host": 0.0, "cxd": 0.0, "mq": 0.0}
+    n_syms = [0]
+    floor_lam = [0.0]
     t_wall0 = time.perf_counter()
 
     def dispatch(chunk: _Chunk) -> None:
@@ -770,7 +791,8 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         batch = np.stack([img[y0:y0 + chunk.plan.tile_h,
                               x0:x0 + chunk.plan.tile_w]
                           for _, y0, x0 in chunk.members])
-        chunk.pending = frontend.dispatch_frontend(chunk.plan, batch)
+        chunk.pending = frontend.dispatch_frontend(
+            chunk.plan, batch, mode="cxd" if use_cxd else "rows")
         tm["device"] += time.perf_counter() - t0
 
     def resolve(chunk: _Chunk) -> None:
@@ -792,22 +814,49 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         tm["host"] += time.perf_counter() - t0
         return blocks
 
+    def host_replay(chunk: _Chunk, streams) -> list:
+        """The CX/D-mode host half: pure MQ replay of the device's
+        symbol streams — no context modeling left on the host."""
+        t0 = time.perf_counter()
+        blocks = t1_batch.encode_cxd(streams)
+        if not params.lossless:
+            _correct_distortions(blocks, chunk.fres)
+        dt = time.perf_counter() - t0
+        tm["host"] += dt
+        tm["mq"] += dt
+        return blocks
+
     def fetch_and_submit(pool, chunk: _Chunk, floors: np.ndarray,
                          futs: list, release_rows: bool) -> None:
         t0 = time.perf_counter()
-        src, offsets = frontend.payload_plan(chunk.fres.nbps, floors,
-                                             chunk.fres.layout.P)
-        payload = frontend.fetch_payload(chunk.fres, src)
-        tm["device"] += time.perf_counter() - t0
-        if release_rows:
-            chunk.fres.rows = None      # free the staging buffer in HBM
+        if use_cxd:
+            streams = cxd_mod.run_cxd(
+                chunk.fres.blocks, chunk.fres.nbps, floors,
+                chunk.bandnames, chunk.hs, chunk.ws,
+                chunk.fres.layout.P, frac_bits)
+            dt = time.perf_counter() - t0
+            tm["device"] += dt
+            tm["cxd"] += dt
+            n_syms[0] += streams.total_syms
+            if release_rows:
+                chunk.fres.blocks = None    # free the HBM staging buffer
+        else:
+            src, offsets = frontend.payload_plan(chunk.fres.nbps, floors,
+                                                 chunk.fres.layout.P)
+            payload = frontend.fetch_payload(chunk.fres, src)
+            tm["device"] += time.perf_counter() - t0
+            if release_rows:
+                chunk.fres.rows = None  # free the staging buffer in HBM
         # Back-pressure: at most HOST_QUEUE_DEPTH unfinished host jobs
         # so payload staging stays bounded.
         live = [f for f in futs if not f.done()]
         if len(live) > HOST_QUEUE_DEPTH:
             live[0].result()
-        futs.append(pool.submit(host_code, chunk, floors, payload,
-                                offsets))
+        if use_cxd:
+            futs.append(pool.submit(host_replay, chunk, streams))
+        else:
+            futs.append(pool.submit(host_code, chunk, floors, payload,
+                                    offsets))
 
     def chunk_floors(margin: float) -> list:
         if target is None:
@@ -825,8 +874,8 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         refd = np.concatenate([padp(c.fres.refd) for c in chunks])
         wts = np.concatenate([c.wts for c in chunks])
         ns = np.concatenate([c.ns for c in chunks])
-        floors = rate_mod.estimate_floors(nbps, newsig, sigd, refd,
-                                          wts, ns, target, margin)
+        floors, floor_lam[0] = rate_mod.estimate_floors(
+            nbps, newsig, sigd, refd, wts, ns, target, margin)
         out, ofs = [], 0
         for c in chunks:
             out.append(floors[ofs:ofs + c.fres.n_blocks])
@@ -878,7 +927,26 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
                 avail = sum(len(b.data) for blocks in blocks_by_chunk
                             for b in blocks)
                 if avail >= 1.05 * target:
-                    break
+                    if attempt == 2 or avail >= 2.0 * target:
+                        # Out of retries, or supply is so abundant that
+                        # PCRD's cut sits far above the floor tail —
+                        # skip the per-pass slope walk on the common
+                        # path (it costs Python time per pass).
+                        break
+                    # Supply is snug: the floors may have clipped
+                    # *cheap* passes PCRD wanted. Compare the realized
+                    # PCRD cut slope against the floor threshold (the
+                    # granted safety plane covers modest gaps; a 4x
+                    # violation means real quality loss).
+                    flat = [b for blocks in blocks_by_chunk
+                            for b in blocks]
+                    wts_all = np.concatenate([c.wts for c in chunks])
+                    realized = rate_mod.cut_slope(flat, wts_all,
+                                                  target * 0.96)
+                    if realized >= floor_lam[0] / 4.0:
+                        break
+                    if _metrics_sink is not None:
+                        _metrics_sink.count("encode.floor_slope_retries")
                 # Estimator undershoot: lower the floors and redo —
                 # PCRD needs enough passes to spend the budget.
                 margin *= 4.0
@@ -888,6 +956,15 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         _metrics_sink.record("encode.device_dispatch", tm["device"],
                              pixels=h * w)
         _metrics_sink.record("encode.host_code", tm["host"], pixels=h * w)
+        if use_cxd:
+            # The Tier-1 split's own segments: device context modeling
+            # vs host MQ replay, plus symbol throughput (/metrics shows
+            # items_per_s on the replay stage).
+            _metrics_sink.record("encode.cxd_device", tm["cxd"],
+                                 pixels=h * w)
+            _metrics_sink.record("encode.mq_replay", tm["mq"],
+                                 pixels=h * w, items=n_syms[0])
+            _metrics_sink.count("encode.cxd_symbols", n_syms[0])
         _metrics_sink.record_overlap("encode", tm["device"], tm["host"],
                                      wall_s, pixels=h * w)
 

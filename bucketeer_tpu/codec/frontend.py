@@ -120,19 +120,25 @@ def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
     return (b * w).sum(axis=-1).astype(jnp.uint8).reshape(n, ROW_BYTES)
 
 
-def _frontend_body(plan: TilePlan, P: int, frac_bits: int,
+def _frontend_body(plan: TilePlan, P: int, frac_bits: int, mode: str,
                    step_map, batch: jnp.ndarray):
-    """The full device program for one tile batch."""
+    """The full device program for one tile batch.
+
+    ``mode``: "rows" packs per-plane bitmaps for the host coder's packed
+    path; "cxd" skips the packing and returns the blockified int32
+    coefficient planes instead — they stay in HBM as the input of the
+    CX/D context-modeling stage (codec/cxd.py)."""
     planes = _transform_batch(plan, step_map, batch)
     blocks = _blockify(planes, plan)
     mag_fp = jnp.abs(blocks)
     idx = (mag_fp >> frac_bits).astype(jnp.uint32)
     maxidx = idx.max(axis=(1, 2)).astype(jnp.int32)
 
-    rows = [_pack_bits(blocks < 0)]      # sign plane first
-    for p in range(P):
-        rows.append(_pack_bits((idx >> p) & 1))
-    rows = jnp.stack(rows, axis=1)       # (N, P+1, 512)
+    if mode == "rows":
+        rows = [_pack_bits(blocks < 0)]      # sign plane first
+        for p in range(P):
+            rows.append(_pack_bits((idx >> p) & 1))
+        rows = jnp.stack(rows, axis=1)       # (N, P+1, 512)
 
     if frac_bits:
         tv = mag_fp.astype(jnp.float32) * (1.0 / (1 << frac_bits))
@@ -162,22 +168,26 @@ def _frontend_body(plan: TilePlan, P: int, frac_bits: int,
         refd.append(rd.sum(axis=(1, 2), dtype=jnp.float32))
     stats = (maxidx, jnp.stack(newsig, 1), jnp.stack(sigd, 1),
              jnp.stack(refd, 1))
-    return rows.reshape(-1, ROW_BYTES), stats
+    if mode == "rows":
+        return rows.reshape(-1, ROW_BYTES), stats
+    return blocks, stats
 
 
 @lru_cache(maxsize=256)
-def _compiled_frontend(plan: TilePlan, P: int):
+def _compiled_frontend(plan: TilePlan, P: int, mode: str = "rows"):
     frac_bits = 0 if plan.lossless else FRAC_BITS
     step_map = jnp.asarray(_step_map(plan)) if not plan.lossless else None
     return jax.jit(retrace.instrument(
-        "frontend", partial(_frontend_body, plan, P, frac_bits,
+        "frontend", partial(_frontend_body, plan, P, frac_bits, mode,
                             step_map)))
 
 
 @dataclass
 class FrontendResult:
     """Per tile-batch device output. ``rows`` stays on device until
-    fetch_payload pulls the compacted subset."""
+    fetch_payload pulls the compacted subset. In CX/D mode (``mode=
+    "cxd"``) ``rows`` is None and ``blocks`` holds the blockified int32
+    coefficient planes instead — the input of codec/cxd.py."""
     layout: FrontendLayout
     n_tiles: int          # real (unpadded) tiles in the batch
     rows: object          # jax array (B*n_per_tile*(P+1), 512) uint8
@@ -185,6 +195,7 @@ class FrontendResult:
     newsig: np.ndarray    # (n_blocks, P) int32
     sigd: np.ndarray      # (n_blocks, P) float32
     refd: np.ndarray      # (n_blocks, P) float32
+    blocks: object = None  # jax array (B*n_per_tile, 64, 64) int32
 
     @property
     def n_blocks(self) -> int:
@@ -202,8 +213,9 @@ class PendingFrontend:
     packed payload is Tier-1 coded on host threads."""
     layout: FrontendLayout
     n_tiles: int
-    rows: object          # device array, stays in HBM
+    rows: object          # device array, stays in HBM (None in cxd mode)
     stats: object         # device array tuple (maxidx, newsig, sigd, refd)
+    blocks: object = None  # device array (cxd mode only)
 
     def resolve_stats(self) -> FrontendResult:
         """Block for the per-block stats (a few KB) and build the
@@ -229,14 +241,18 @@ class PendingFrontend:
                 f"{caps[bad][int(np.argmax(nbps[bad]))]} (coefficient "
                 "overflow in the device front-end)")
         return FrontendResult(self.layout, self.n_tiles, self.rows, nbps,
-                              newsig[:n], sigd[:n], refd[:n])
+                              newsig[:n], sigd[:n], refd[:n],
+                              blocks=self.blocks)
 
 
 @contract(shapes={"tiles": [("B", "h", "w"), ("B", "h", "w", "C")]},
           dtypes={"tiles": "number"})
-def dispatch_frontend(plan: TilePlan, tiles: np.ndarray) -> PendingFrontend:
+def dispatch_frontend(plan: TilePlan, tiles: np.ndarray,
+                      mode: str = "rows") -> PendingFrontend:
     """Queue transform + blockify + stats for a (B, h, w[, C]) tile
-    batch on the device and return without waiting for the result."""
+    batch on the device and return without waiting for the result.
+    ``mode="cxd"`` keeps the raw blockified coefficients on device for
+    the CX/D stage instead of packing bit-plane bitmaps."""
     if tiles.ndim == 3:
         tiles = tiles[..., None]
     b = tiles.shape[0]
@@ -245,8 +261,11 @@ def dispatch_frontend(plan: TilePlan, tiles: np.ndarray) -> PendingFrontend:
         tiles = np.concatenate(
             [tiles, np.zeros((pad,) + tiles.shape[1:], tiles.dtype)])
     layout = layout_for(plan)
-    rows, stats = _compiled_frontend(plan, layout.P)(jnp.asarray(tiles))
-    return PendingFrontend(layout, b, rows, stats)
+    out, stats = _compiled_frontend(plan, layout.P, mode)(
+        jnp.asarray(tiles))
+    if mode == "rows":
+        return PendingFrontend(layout, b, out, stats)
+    return PendingFrontend(layout, b, None, stats, blocks=out)
 
 
 @contract(shapes={"tiles": [("B", "h", "w"), ("B", "h", "w", "C")]},
@@ -297,24 +316,30 @@ def payload_plan(nbps: np.ndarray, floors: np.ndarray, P: int):
     return src, offsets
 
 
-@contract(shapes={"src": ("R",)}, dtypes={"src": "integer"})
-def fetch_payload(result: FrontendResult, src: np.ndarray) -> np.ndarray:
-    """Compact the selected rows on device and copy them host-side in
-    fixed-size gather chunks (one compiled program, bounded padding).
-    Returns (R, 512) uint8."""
+def gather_rows(rows, src: np.ndarray, row_bytes: int) -> np.ndarray:
+    """Compact selected rows of a device (R_total, row_bytes) uint8
+    array and copy them host-side in fixed-size gather chunks (one
+    compiled program per row width, bounded padding). Shared by the
+    packed-bitmap payload fetch and the CX/D symbol-stream fetch."""
     r = len(src)
     if r == 0:
-        return np.empty((0, ROW_BYTES), dtype=np.uint8)
+        return np.empty((0, row_bytes), dtype=np.uint8)
     padded = -(-r // GATHER_CHUNK) * GATHER_CHUNK
     src_pad = np.zeros(padded, dtype=np.int64)
     src_pad[:r] = src
     gather = _compiled_gather(GATHER_CHUNK)
     outs = []
     for i in range(0, padded, GATHER_CHUNK):
-        outs.append(gather(result.rows,
-                           jnp.asarray(src_pad[i:i + GATHER_CHUNK])))
+        outs.append(gather(rows, jnp.asarray(src_pad[i:i + GATHER_CHUNK])))
     out = np.concatenate([np.asarray(jax.device_get(o)) for o in outs])
     return out[:r]
+
+
+@contract(shapes={"src": ("R",)}, dtypes={"src": "integer"})
+def fetch_payload(result: FrontendResult, src: np.ndarray) -> np.ndarray:
+    """Compact the selected bitmap rows on device and copy them host-side.
+    Returns (R, 512) uint8."""
+    return gather_rows(result.rows, src, ROW_BYTES)
 
 
 def unpack_block(payload: np.ndarray, offset: int, nbp: int, floor: int,

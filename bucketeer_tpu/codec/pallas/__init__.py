@@ -1,17 +1,23 @@
-"""Reserved for hand-written Pallas TPU kernels.
+"""Hand-written Pallas TPU kernels.
 
-Planned role: fuse the Tier-1 front-end's bit-plane packing and
-significance statistics (codec/frontend.py) into a single custom kernel
-once the plain-jnp formulation stops scaling — the packing step's
-``(N, 64, 8, 8) -> (N, 512)`` byte assembly is the likeliest candidate
-for a Pallas rewrite because XLA materializes an intermediate the kernel
-could keep in registers.
+First (and so far only) kernel: the EBCOT CX/D stripe scan
+(:mod:`.cxd_scan`) — the device half of the Tier-1 split that ships
+context-modeling symbol streams, not work, to the host MQ coder
+(codec/cxd.py, ``BUCKETEER_DEVICE_CXD``). It keeps a code-block's
+significance state and symbol buffer resident in VMEM for the whole
+plane walk instead of letting XLA spill the batched scan state through
+HBM.
 
-Nothing here is implemented yet. The front-end runs entirely as jitted
-jnp today; an earlier docstring claimed otherwise and was reverted
-(commit b4c697b), which is why the empty-package lint rule
-(``graftlint: empty-package``) now requires this stub to say so
-explicitly. When adding the first kernel, read the TPU guide under
-/opt/skills/guides/ first and keep the jnp path as the fallback for
-CPU-backend tests.
+Selection: codec/cxd.py picks the Pallas kernel on the TPU backend and
+the plain-jnp ``lax.scan`` formulation elsewhere (CPU dev mode, tests);
+``BUCKETEER_CXD_PALLAS=1/0`` forces either way. Both implementations
+share one step function, and interpret-mode parity tests
+(tests/test_cxd.py) pin them to each other and to the codec/t1.py
+reference coder.
+
+The earlier plan recorded here — fusing the bit-plane packing of the
+packed-bitmap path into a kernel — is superseded: the CX/D split removes
+that packing from the hot path entirely. When adding kernels, read the
+TPU guide under /opt/skills/guides/ first and keep a jnp fallback for
+the CPU backend.
 """

@@ -1,0 +1,115 @@
+"""Pallas TPU kernel for the EBCOT CX/D stripe scan (codec/cxd.py).
+
+The first hand-written kernel in this package. One code-block per grid
+cell: the block's (64, 64) int32 coefficients land in VMEM, the kernel
+runs the same stripe-column step function the jnp path scans with
+(``cxd._make_step`` — shared verbatim, so the two implementations cannot
+drift), carrying the significance state, symbol buffer and pass
+counters through a ``lax.fori_loop`` over the P*3*1024 plane/pass/column
+steps, and writes the per-block symbol stream + pass tables back out.
+
+Why Pallas at all: the jnp formulation materializes the scan as an XLA
+while-loop over (N, ...) batched state with one dynamic-slice/scatter
+bundle per stripe column — fine on CPU, but on TPU the batched gathers
+round-trip through HBM layouts the compiler picks. Here the whole
+working set (state ~17 KB, symbol buffer ~100 KB, coefficients 16 KB)
+is pinned in VMEM for the kernel's lifetime and only the finished
+streams leave the core.
+
+Status: semantics are locked to the jnp path by interpret-mode parity
+tests (tests/test_cxd.py) on every CI run; the compiled-on-real-TPU
+path is selected by ``BUCKETEER_CXD_PALLAS`` (default: auto — TPU
+backend only) and can be disabled with ``BUCKETEER_CXD_PALLAS=0`` if a
+Mosaic version rejects the scalar-indexed updates.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:                                    # CPU-only jaxlibs lack the TPU ext
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:                     # pragma: no cover
+    pltpu = None
+
+from .. import cxd
+
+CBLK = cxd.CBLK
+
+
+def _kernel(P: int, frac_bits: int, n_steps: int,
+            coeff_ref, meta_ref, zc_ref, scc_ref, scx_ref,
+            buf_ref, counts_ref, dh_ref, dl_ref, cur_ref):
+    coeffs = coeff_ref[0]
+    nbp, floor = meta_ref[0, 0], meta_ref[0, 1]
+    cls, h, w = meta_ref[0, 2], meta_ref[0, 3], meta_ref[0, 4]
+    idx = (jnp.abs(coeffs) >> frac_bits).astype(jnp.int32)
+    idx = (idx >> floor) << floor       # packed-path floor truncation
+    neg = (coeffs < 0).astype(jnp.int32)
+    step = cxd._make_step(P, idx, neg, nbp, floor, cls, h, w,
+                          tables=(zc_ref[:], scc_ref[:], scx_ref[:]))
+
+    def body(t, carry):
+        # Decode the flat step index into (plane, pass, stripe, column)
+        # — same order as cxd.scan_xs, planes descending.
+        plane = P - 1 - t // (3 * cxd.COLS_PER_PLANE)
+        rem = t % (3 * cxd.COLS_PER_PLANE)
+        pt = rem // cxd.COLS_PER_PLANE
+        s = rem % cxd.COLS_PER_PLANE
+        xt = jnp.stack([plane, pt, (s // CBLK) * 4, s % CBLK])
+        return step(carry, xt)[0]
+
+    _, _, _, cur, buf, counts, dh, dl = lax.fori_loop(
+        0, n_steps, body, cxd.init_state(P))
+    buf_ref[0] = buf
+    counts_ref[0] = counts
+    dh_ref[0] = dh
+    dl_ref[0] = dl
+    cur_ref[0, 0] = cur
+
+
+def cxd_pallas(P: int, frac_bits: int, blocks, nbps, floors, cls, hs, ws,
+               interpret: bool = False):
+    """Drop-in replacement for the vmapped jnp scan: (N, 64, 64) int32
+    blocks -> (buf (N, max_syms) uint8, counts (N, P, 3) int32,
+    dh/dl (N, P, 3) float32, cursors (N,) int32)."""
+    n = blocks.shape[0]
+    msym = cxd.max_syms(P)
+    n_steps = P * 3 * cxd.COLS_PER_PLANE
+    meta = jnp.stack([nbps, floors, cls, hs, ws], axis=1).astype(jnp.int32)
+    sc_c, sc_x = cxd._sc_tables()
+    zc = jnp.asarray(cxd._zc_stack())
+    vmem = dict(memory_space=pltpu.VMEM) if pltpu is not None else {}
+    smem = dict(memory_space=pltpu.SMEM) if pltpu is not None else {}
+    buf, counts, dh, dl, cur = pl.pallas_call(
+        partial(_kernel, P, frac_bits, n_steps),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, CBLK, CBLK), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, 5), lambda b: (b, 0), **smem),
+            pl.BlockSpec(zc.shape, lambda b: (0, 0, 0, 0), **vmem),
+            pl.BlockSpec(sc_c.shape, lambda b: (0, 0), **vmem),
+            pl.BlockSpec(sc_x.shape, lambda b: (0, 0), **vmem),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, msym), lambda b: (b, 0), **vmem),
+            pl.BlockSpec((1, P, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, P, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, P, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, 1), lambda b: (b, 0), **smem),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, msym), jnp.uint8),
+            jax.ShapeDtypeStruct((n, P, 3), jnp.int32),
+            jax.ShapeDtypeStruct((n, P, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n, P, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        ),
+        interpret=interpret,
+    )(blocks.astype(jnp.int32), meta, zc, jnp.asarray(sc_c),
+      jnp.asarray(sc_x))
+    return buf, counts, dh, dl, cur[:, 0]

@@ -39,10 +39,18 @@ A_SIG = 2.8
 A_REF = 0.95
 
 
+# A block whose top plane's amortized slope clears the estimator's cut
+# threshold divided by this factor is never fully zeroed: it keeps at
+# least its MSB plane. Dropping such a block outright risked visible
+# quality loss the aggregate byte check could not see (ADVICE r5 #4);
+# one top plane of insurance costs ~a few bytes per block.
+LIVE_BLOCK_SLACK = 16.0
+
+
 def estimate_floors(nbps: np.ndarray, newsig: np.ndarray,
                     sigd: np.ndarray, refd: np.ndarray,
                     weights: np.ndarray, n_samples: np.ndarray,
-                    target_bytes: float, margin: float = 3.0) -> np.ndarray:
+                    target_bytes: float, margin: float = 3.0):
     """Choose a per-block lowest bit-plane to code, from device front-end
     statistics (codec/frontend.py), so Tier-1 skips work (and the device
     skips transfer) that PCRD-opt would discard anyway.
@@ -51,8 +59,13 @@ def estimate_floors(nbps: np.ndarray, newsig: np.ndarray,
     weights, n_samples (N,) true samples per block. Picks the largest
     slope threshold whose contiguous-from-MSB plane selection costs
     ~margin x target_bytes by the pass-size model above, then grants one
-    extra plane of safety. Returns floors (N,); a floor == nbp marks a
-    block that ships nothing (it would not survive rate control).
+    extra plane of safety. Returns (floors (N,), cut_slope): a floor ==
+    nbp marks a block that ships nothing — but a live block whose top
+    plane clears the threshold / LIVE_BLOCK_SLACK always keeps its MSB
+    plane. ``cut_slope`` is the slope threshold actually applied; the
+    encoder compares it to PCRD's realized cut to detect floors that
+    clipped passes the allocator wanted (and then retries with a bigger
+    margin).
     """
     n, P = newsig.shape
     planes = np.arange(P)
@@ -82,7 +95,7 @@ def estimate_floors(nbps: np.ndarray, newsig: np.ndarray,
     budget = margin * target_bytes
     pos = slope_mono[valid & (slope_mono > 0)]
     if pos.size == 0:
-        return nbps.copy()
+        return nbps.copy(), 0.0
 
     def cost_at(lam: float) -> float:
         inc = valid & (slope_mono >= lam)
@@ -100,10 +113,48 @@ def estimate_floors(nbps: np.ndarray, newsig: np.ndarray,
     included = valid & (slope_mono >= hi)
     any_inc = included.any(axis=1)
     # One extra plane of safety below the estimated cut for live blocks;
-    # blocks with nothing over the threshold ship nothing at all.
+    # blocks with nothing over the threshold ship nothing — unless their
+    # top plane clears the loose threshold, in which case they keep the
+    # MSB plane (never fully zero a plausibly-live block, ADVICE r5 #4).
     lowest = np.argmax(included, axis=1)
+    live = nbps > 0
+    top_slope = np.where(
+        live, slope_mono[np.arange(n), np.maximum(nbps - 1, 0)], 0.0)
+    keep_top = (~any_inc) & live & (top_slope >= hi / LIVE_BLOCK_SLACK)
     floors = np.where(any_inc, np.maximum(0, lowest - 1), nbps)
-    return np.minimum(floors, nbps).astype(np.int32)
+    floors = np.where(keep_top, nbps - 1, floors)
+    return np.minimum(floors, nbps).astype(np.int32), float(hi)
+
+
+def cut_slope(blocks: list, weights: list,
+              target_bytes: float | None) -> float:
+    """Approximate realized PCRD cut: the marginal R-D slope at the
+    byte budget, from raw per-pass slopes (no hull amortization — one
+    cheap numpy pass instead of rebuilding every block hull the
+    allocator will build again anyway). The encoder compares this
+    against estimate_floors' threshold with 4x slack — a realized cut
+    far below the floor threshold means the floors clipped passes PCRD
+    wanted, so the floor pass must be redone with a bigger margin."""
+    if target_bytes is None:
+        return 0.0
+    slopes, lens = [], []
+    for blk, w in zip(blocks, weights):
+        prev = 0
+        for p in blk.passes:
+            dl = p.cum_length - prev
+            prev = p.cum_length
+            if dl > 0 and p.dist_reduction > 0:
+                slopes.append(p.dist_reduction * w / dl)
+                lens.append(dl)
+    if not slopes:
+        return 0.0
+    s = np.asarray(slopes)
+    order = np.argsort(-s)
+    cum = np.cumsum(np.asarray(lens, dtype=np.float64)[order])
+    k = int(np.searchsorted(cum, target_bytes))
+    if k >= len(s):
+        return 0.0      # everything fit: the cut never bound
+    return float(s[order[k]])
 
 
 @dataclass

@@ -65,6 +65,13 @@ def _build_zc_tables():
 
 _ZC_LL_LH, _ZC_HH = _build_zc_tables()
 
+# Band name -> context-table class, shared by every consumer (the native
+# batch entry, the device CX/D stage, and this reference coder's HL
+# transposition convention): 0 = LL/LH table, 1 = HH table, 2 = HL
+# (LL/LH with the H and V roles swapped). One table — a drifted copy
+# would silently break device-vs-host byte parity.
+BAND_CLS = {"LL": 0, "LH": 0, "HH": 1, "HL": 2}
+
 # Sign-coding context + XOR bit from (h, v) in {-1,0,1} (Table D.3).
 _SC = {}
 for _h in (-1, 0, 1):
@@ -95,7 +102,7 @@ class CodedBlock:
 
 def encode_block(mags: np.ndarray, signs: np.ndarray, band: str,
                  fracs: np.ndarray | None = None,
-                 floor: int = 0) -> CodedBlock:
+                 floor: int = 0, mq: MQEncoder | None = None) -> CodedBlock:
     """Encode one code-block.
 
     mags: (h, w) uint32 magnitudes (quantizer indices); signs: (h, w)
@@ -105,7 +112,9 @@ def encode_block(mags: np.ndarray, signs: np.ndarray, band: str,
     means the indices are exact (reversible path); floor: lowest coded
     bit-plane (planes below it are omitted from the pass list — a
     truncation the rate allocator would have made; the caller must have
-    zeroed the corresponding magnitude bits).
+    zeroed the corresponding magnitude bits); mq: optional MQEncoder
+    stand-in (codec/cxd.py injects a recording coder to extract the
+    reference CX/D symbol stream).
     """
     h, w = mags.shape
     maxv = int(mags.max()) if mags.size else 0
@@ -118,7 +127,7 @@ def encode_block(mags: np.ndarray, signs: np.ndarray, band: str,
     swap_hv = band == "HL"
     zc_table = _ZC_HH if band == "HH" else _ZC_LL_LH
 
-    mq = MQEncoder()
+    mq = mq or MQEncoder()
     sigma = np.zeros((h, w), dtype=np.uint8)
     pi = np.zeros((h, w), dtype=np.uint8)      # coded-in-current-plane flag
     refined = np.zeros((h, w), dtype=np.uint8)

@@ -15,7 +15,20 @@ from .. import native
 from ..analysis.contracts import contract
 from . import t1
 
-_BAND_CLS = {"LL": 0, "LH": 0, "HH": 1, "HL": 2}
+_BAND_CLS = t1.BAND_CLS        # single source of the band-class table
+
+# Concurrency bookkeeping for the native dispatch. ctypes releases the
+# GIL for the duration of every CDLL call (only PyDLL keeps it), so the
+# encoder's host-coding worker genuinely overlaps the main thread's
+# device dispatch — tests/test_native_t1.py proves it by running Python
+# work concurrently with a native call. Each native entry records the
+# thread-pool size it fanned out to so sizing regressions (e.g. an env
+# override silently pinning the pool to 1) are observable.
+last_native_call: dict = {}
+
+
+def _note_call(fn: str, n_blocks: int, threads: int) -> None:
+    last_native_call.update(fn=fn, n_blocks=n_blocks, threads=threads)
 
 
 def default_threads() -> int:
@@ -80,10 +93,12 @@ def encode_packed(payload: np.ndarray, offsets: np.ndarray,
         floors_c = np.ascontiguousarray(floors, dtype=np.int32)
         hs_c = np.ascontiguousarray(hs, dtype=np.int32)
         ws_c = np.ascontiguousarray(ws, dtype=np.int32)
+        threads = default_threads()
+        _note_call("t1_encode_packed", n, threads)
         handle = lib.t1_encode_packed(
             n, payload.ctypes.data, offs.ctypes.data, nbps_c.ctypes.data,
             floors_c.ctypes.data, hs_c.ctypes.data, ws_c.ctypes.data,
-            cls.ctypes.data, default_threads())
+            cls.ctypes.data, threads)
         return _collect(lib, handle, n)
     out = []
     for i in range(n):
@@ -129,9 +144,58 @@ def encode_blocks(specs: list) -> list:
             fracs[offsets[i]:offsets[i + 1]] = np.ascontiguousarray(
                 f, dtype=np.uint8).ravel()
 
+    threads = default_threads()
+    _note_call("t1_encode_blocks", n, threads)
     handle = lib.t1_encode_blocks(
         n, mags.ctypes.data, negs.ctypes.data,
         fracs.ctypes.data if fracs is not None else None,
         offsets.ctypes.data,
-        hs.ctypes.data, ws.ctypes.data, cls.ctypes.data, default_threads())
+        hs.ctypes.data, ws.ctypes.data, cls.ctypes.data, threads)
     return _collect(lib, handle, n)
+
+
+def encode_cxd(streams) -> list:
+    """MQ replay of precomputed device CX/D streams (codec/cxd.py) —
+    the host half of the BUCKETEER_DEVICE_CXD Tier-1 split. Native
+    thread pool when available, pure-Python MQEncoder replay otherwise.
+    Returns [t1.CodedBlock] in block order, byte-identical to what
+    encode_packed would have produced from the same coefficients."""
+    from . import cxd
+
+    n = len(streams.nbps)
+    lib = native.load()
+    if lib is not None and n:
+        payload = np.ascontiguousarray(streams.payload, dtype=np.uint8)
+        row_offs = np.ascontiguousarray(streams.row_offsets,
+                                        dtype=np.int64)
+        nbps_c = np.ascontiguousarray(streams.nbps, dtype=np.int32)
+        p_offs = np.ascontiguousarray(streams.pass_offsets,
+                                      dtype=np.int64)
+        p_types = np.ascontiguousarray(streams.pass_types, dtype=np.int32)
+        p_planes = np.ascontiguousarray(streams.pass_planes,
+                                        dtype=np.int32)
+        p_nsyms = np.ascontiguousarray(streams.pass_nsyms, dtype=np.int32)
+        p_dists = np.ascontiguousarray(streams.pass_dists,
+                                       dtype=np.float64)
+        threads = default_threads()
+        _note_call("t1_encode_cxd", n, threads)
+        handle = lib.t1_encode_cxd(
+            n, payload.ctypes.data, row_offs.ctypes.data,
+            nbps_c.ctypes.data, p_offs.ctypes.data, p_types.ctypes.data,
+            p_planes.ctypes.data, p_nsyms.ctypes.data,
+            p_dists.ctypes.data, threads)
+        return _collect(lib, handle, n)
+
+    out = []
+    for b in range(n):
+        p0, p1 = int(streams.pass_offsets[b]), int(
+            streams.pass_offsets[b + 1])
+        n_syms = int(streams.pass_nsyms[p0:p1].sum())
+        start = int(streams.row_offsets[b])
+        n_rows = -(-n_syms // cxd.SYMS_PER_ROW)
+        syms = cxd.unpack6(streams.payload[start:start + n_rows], n_syms)
+        out.append(cxd.replay_block(
+            syms, int(streams.nbps[b]), p1 - p0, streams.pass_types[p0:p1],
+            streams.pass_planes[p0:p1], streams.pass_nsyms[p0:p1],
+            streams.pass_dists[p0:p1]))
+    return out

@@ -55,6 +55,14 @@ MESH_MIN_PIXELS = "bucketeer.mesh.min.pixels"
 # reference hardwires LOSSLESS at ImageWorkerVerticle.java:58-64; here it
 # is a default, not a constant) or "lossy".
 CONVERSION_TYPE = "bucketeer.conversion.type"
+# Tier-1 split: run EBCOT context modeling on the device and replay the
+# CX/D streams through the host MQ coder (codec/cxd.py). Truthy enables,
+# "0"/empty disables, absent defers to the BUCKETEER_DEVICE_CXD env.
+DEVICE_CXD = "bucketeer.tpu.device.cxd"
+# JAX persistent compilation cache directory: repeated bench/server runs
+# reuse compiled XLA programs instead of recompiling at boot. Env analog:
+# BUCKETEER_COMPILE_CACHE (converters/tpu.py wires both).
+COMPILE_CACHE = "bucketeer.tpu.compile.cache"
 
 # Every known key (env overlay applies to these even without defaults).
 ALL_KEYS = (
@@ -66,7 +74,7 @@ ALL_KEYS = (
     FILESYSTEM_CSV_MOUNT, FILESYSTEM_PREFIX, SLACK_OAUTH_TOKEN,
     SLACK_CHANNEL_ID, SLACK_ERROR_CHANNEL_ID, SLACK_WEBHOOK_URL,
     FEATURE_FLAGS, TPU_LOSSY_RATE, TPU_BATCH_SIZE, TPU_MESH_SHAPE,
-    MESH_MIN_PIXELS, CONVERSION_TYPE,
+    MESH_MIN_PIXELS, CONVERSION_TYPE, DEVICE_CXD, COMPILE_CACHE,
 )
 
 _DEFAULTS: dict[str, Any] = {
@@ -82,6 +90,17 @@ _DEFAULTS: dict[str, Any] = {
     TPU_BATCH_SIZE: 8,
     TPU_MESH_SHAPE: "",
 }
+
+
+def truthy(value) -> bool:
+    """Shared boolean parsing for env vars and config values: None,
+    "", "0", "false", "no" and "off" (case-insensitive) are falsy,
+    anything else is truthy. Every flag-style switch goes through here
+    so "FLAG=false" means the same thing on every surface."""
+    if value is None:
+        return False
+    return str(value).strip().lower() not in ("", "0", "false", "no",
+                                              "off")
 
 
 @dataclass

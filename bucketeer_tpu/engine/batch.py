@@ -56,6 +56,17 @@ class BatchConverterWorker:
         if mesh_px and hasattr(converter, "mesh_min_pixels"):
             converter.mesh_min_pixels = mesh_px
             LOG.info("mesh routing threshold set to %d pixels", mesh_px)
+        # Tier-1 split and compile cache (converters/tpu.py): the config
+        # keys override the converter's env-driven defaults.
+        cxd_flag = config.get_str(cfg.DEVICE_CXD)
+        if cxd_flag is not None and hasattr(converter, "device_cxd"):
+            converter.device_cxd = cfg.truthy(cxd_flag)
+            LOG.info("device CX/D Tier-1 split %s by config",
+                     "enabled" if converter.device_cxd else "disabled")
+        cache_dir = config.get_str(cfg.COMPILE_CACHE)
+        if cache_dir:
+            from ..converters.tpu import maybe_enable_compile_cache
+            maybe_enable_compile_cache(cache_dir)
 
     def register(self, bus: MessageBus, instances: int = 2) -> None:
         bus.consumer(BATCH_CONVERTER, self.handle, instances=instances)

@@ -23,7 +23,7 @@ LOG = logging.getLogger(__name__)
 
 _DIR = Path(__file__).resolve().parent
 _LIB_PATH = _DIR / "libbucketeer_t1.so"
-_ABI_VERSION = 3     # must match t1_abi_version() in t1.cpp
+_ABI_VERSION = 4     # must match t1_abi_version() in t1.cpp
 _lib = None
 _tried = False
 
@@ -129,6 +129,11 @@ def load():
         ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_int]
+    lib.t1_encode_cxd.restype = ctypes.c_void_p
+    lib.t1_encode_cxd.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int]
     lib.t1_block_sizes.restype = None
     lib.t1_block_sizes.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 3
     lib.t1_block_get.restype = None

@@ -433,7 +433,7 @@ extern "C" {
 // refuses a library whose version doesn't match, so a stale prebuilt
 // .so (deployment images may prune t1.cpp) fails loudly instead of
 // misreading the new argument layout.
-int32_t t1_abi_version() { return 3; }
+int32_t t1_abi_version() { return 4; }
 
 T1Result* t1_encode_blocks(int n_blocks,
                            const uint32_t* mags, const uint8_t* negs,
@@ -485,6 +485,62 @@ T1Result* t1_encode_packed(int n_blocks, const uint8_t* payload,
         }
         encode_block(mags, negs, nullptr, h, w, bandcls[i], floor,
                      res->blocks[i]);
+    });
+    return res;
+}
+
+// CX/D replay entry (the device context-modeling path, codec/cxd.py):
+// the device already ran significance propagation / magnitude
+// refinement / cleanup and shipped the ordered (context, decision)
+// symbol stream; the host just replays it through the MQ coder — no
+// neighborhood state, no bit-plane walks. payload: 384-byte rows of
+// 6-bit symbols, four per little-endian 24-bit group, symbol = ctx
+// (low 5 bits) | decision << 5; block i's rows start at
+// row_offsets[i]*384. Pass metadata is flat across blocks: block i owns
+// passes [pass_offsets[i], pass_offsets[i+1]) with per-pass symbol
+// counts, types/planes for the PassInfo table, and the device-computed
+// exact distortion reductions passed straight through. nbps[i] is the
+// block's coded bit-plane count (the stream itself no longer reveals
+// it). Blocks with zero passes code as empty (nbps forced 0, like a
+// dead packed block).
+T1Result* t1_encode_cxd(int n_blocks, const uint8_t* payload,
+                        const int64_t* row_offsets,
+                        const int32_t* nbps,
+                        const int64_t* pass_offsets,
+                        const int32_t* pass_types,
+                        const int32_t* pass_planes,
+                        const int32_t* pass_nsyms,
+                        const double* pass_dists, int n_threads) {
+    auto* res = new T1Result();
+    res->blocks.resize(n_blocks);
+    run_pool(n_blocks, n_threads, [&](int i) {
+        BlockOut& out = res->blocks[i];
+        const int64_t p0 = pass_offsets[i], p1 = pass_offsets[i + 1];
+        if (p1 <= p0) return;               // dead block: zero passes
+        const uint8_t* rows = payload + row_offsets[i] * 384;
+        MQEnc mq;
+        int64_t sym = 0;
+        uint32_t word = 0;
+        for (int64_t j = p0; j < p1; j++) {
+            for (int32_t s = 0; s < pass_nsyms[j]; s++, sym++) {
+                const int r = (int)(sym & 3);
+                if (r == 0) {       // one load per 4-symbol group
+                    const uint8_t* g = rows + (sym >> 2) * 3;
+                    word = (uint32_t)g[0] | ((uint32_t)g[1] << 8) |
+                           ((uint32_t)g[2] << 16);
+                }
+                const uint32_t cxd = (word >> (6 * r)) & 63u;
+                mq.encode((int)(cxd >> 5), (int)(cxd & 31u));
+            }
+            out.passes.push_back({pass_types[j], pass_planes[j],
+                                  mq.trunc_length(), pass_dists[j]});
+        }
+        mq.flush();
+        out.nbps = nbps[i];
+        out.data.assign(mq.buf.begin() + 1, mq.buf.end());
+        const int64_t total = (int64_t)out.data.size();
+        for (auto& pr : out.passes)
+            if (pr.cum_len > total) pr.cum_len = total;
     });
     return res;
 }

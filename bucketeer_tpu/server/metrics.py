@@ -18,12 +18,15 @@ class StageStats:
     total_s: float = 0.0
     max_s: float = 0.0
     pixels: int = 0
+    items: int = 0        # stage-specific unit (e.g. CX/D symbols)
 
-    def record(self, seconds: float, pixels: int = 0) -> None:
+    def record(self, seconds: float, pixels: int = 0,
+               items: int = 0) -> None:
         self.count += 1
         self.total_s += seconds
         self.max_s = max(self.max_s, seconds)
         self.pixels += pixels
+        self.items += items
 
 
 @dataclass
@@ -79,9 +82,10 @@ class Metrics:
         finally:
             self.record(stage, time.perf_counter() - t0, pixels)
 
-    def record(self, stage: str, seconds: float, pixels: int = 0) -> None:
+    def record(self, stage: str, seconds: float, pixels: int = 0,
+               items: int = 0) -> None:
         with self._lock:
-            self.stages[stage].record(seconds, pixels)
+            self.stages[stage].record(seconds, pixels, items)
 
     def record_overlap(self, stage: str, device_s: float, host_s: float,
                        wall_s: float, pixels: int = 0) -> None:
@@ -115,6 +119,10 @@ class Metrics:
                 if st.total_s > 0:
                     entry["mpixels_per_s"] = round(
                         st.pixels / 1e6 / st.total_s, 2)
+            if st.items:
+                entry["items"] = st.items
+                if st.total_s > 0:
+                    entry["items_per_s"] = round(st.items / st.total_s, 1)
             out["stages"][name] = entry
         if self.overlaps:
             out["overlap"] = {}

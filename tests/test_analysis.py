@@ -77,6 +77,39 @@ def test_seeded_abi_mismatch(tmp_path):
     assert "4" in findings[0].message and "3" in findings[0].message
 
 
+def test_seeded_abi_arity_mismatch(tmp_path):
+    """argtypes declaring a different argument count than the C++
+    definition takes — including the `[x] + [y] * k` binding idiom —
+    must be exactly one abi-arity-mismatch finding."""
+    native = tmp_path / "native"
+    native.mkdir()
+    (native / "__init__.py").write_text(textwrap.dedent("""\
+        import ctypes
+        _ABI_VERSION = 3
+
+
+        def load(lib):
+            lib.t1_abi_version.restype = ctypes.c_int32
+            lib.t1_encode_cxd.argtypes = [ctypes.c_int] + \\
+                [ctypes.c_void_p] * 2
+            lib.t1_free.argtypes = [ctypes.c_void_p]
+        """), encoding="utf-8")
+    (native / "t1.cpp").write_text(textwrap.dedent("""\
+        #include <cstdint>
+        extern "C" {
+        int32_t t1_abi_version() { return 3; }
+        void t1_encode_cxd(int n, const uint8_t* payload,
+                           const int64_t* offsets, int threads) {}
+        void t1_free(void* r) {}
+        }
+        """), encoding="utf-8")
+    findings = abi.check_native(native)
+    assert _rules(findings) == ["abi-arity-mismatch"]
+    assert "3 argument(s)" in findings[0].message
+    assert "takes 4" in findings[0].message
+    assert "t1_encode_cxd" in findings[0].message
+
+
 def test_seeded_swallowed_exception(tmp_path):
     root = _make_pkg(tmp_path, {"engine/bad.py": """\
         def f(g):

@@ -180,7 +180,7 @@ def test_floor_estimator_conservative(rng, monkeypatch):
     with_floors = encoder.encode_jp2(img, 8, params)
     monkeypatch.setattr(
         rate_mod, "estimate_floors",
-        lambda nbps, *a, **k: np.zeros_like(nbps))
+        lambda nbps, *a, **k: (np.zeros_like(nbps), 0.0))
     without = encoder.encode_jp2(img, 8, params)
     p_f = _psnr(_decode(with_floors), img)
     p_0 = _psnr(_decode(without), img)
