@@ -22,6 +22,13 @@ Backend init is retried with exponential backoff — the recurring
 ``axon ... UNAVAILABLE`` TPU setup error killed BENCH_r02 and r05
 outright — and falls back to CPU after the retries so the harness
 always reports *some* platform-labelled number instead of rc=1.
+Init-time probing is not enough, though: BENCH_r05 showed the same
+error raised at the *first dispatch* (``jax.devices()`` succeeds, the
+first compiled program dies), after the init retry has already passed.
+When a config fails with a backend-unavailable error, the harness
+re-execs itself once under ``JAX_PLATFORMS=cpu`` (a half-initialized
+PJRT plugin cannot be torn down in-process) and the JSON line reports
+``platform_fallback: true`` — bench exits 0 on TPU-less hosts.
 
 Env knobs: BENCH_SMOKE=1 shrinks every config to CI-smoke size;
 BENCH_SIZE / BENCH_REPEATS / BENCH_BATCH_N / BENCH_BATCH_SIZE /
@@ -41,6 +48,40 @@ import numpy as np
 
 BASELINE_MPIX_S = 500.0
 SMOKE = os.environ.get("BENCH_SMOKE", "") not in ("", "0")
+
+# Set (to "1") when the harness re-exec'd itself onto CPU after a
+# backend-unavailable error at run time; the guard also stops a second
+# re-exec if even the CPU run somehow trips the detector.
+_REEXEC_ENV = "BUCKETEER_BENCH_CPU_REEXEC"
+
+
+def _backend_unavailable(exc: BaseException) -> bool:
+    """Recognize the PJRT backend-setup failure that surfaces at first
+    dispatch (BENCH_r05: ``RuntimeError: Unable to initialize backend
+    'axon': UNAVAILABLE ...``), including when a config wrapped it."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        msg = str(exc)
+        if ("Unable to initialize backend" in msg
+                or "TPU backend setup/compile error" in msg
+                or ("UNAVAILABLE" in msg and "backend" in msg)):
+            return True
+        exc = exc.__cause__ or exc.__context__
+    return False
+
+
+def _reexec_on_cpu() -> None:
+    """Replace the process with a CPU-pinned copy of itself. In-process
+    recovery is not possible once a PJRT plugin half-initialized: jitted
+    programs cache backend handles and the failing plugin stays
+    registered, so a clean interpreter is the only reliable path."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env[_REEXEC_ENV] = "1"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def _env_int(name: str, default: int, smoke: int | None = None) -> int:
@@ -432,12 +473,62 @@ def config5_mixed_overlap(repeats: int) -> dict:
             "overlap": "upload behind encode"}
 
 
+def config6_decode(repeats: int) -> dict:
+    """Decode path (the GET /images read endpoint's engine): full decode
+    and a reduce=2 thumbnail read of a lossless JP2, with the
+    per-segment split (decode.t2_parse / mq / t1 / device_inverse).
+    Host Tier-1 decode is pure Python for now, so the default size is
+    modest; the segment report is what tracks where the time goes."""
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.decode import decode, set_metrics_sink
+    from bucketeer_tpu.codec.encoder import EncodeParams
+    from bucketeer_tpu.server.metrics import Metrics
+
+    size = _env_int("BENCH_DECODE_SIZE", 256, smoke=96)
+    img = synthetic_photo(size)
+    params = EncodeParams(lossless=True, levels=4,
+                          tile_size=min(128, size))
+    data = encoder.encode_jp2(img, 8, params)
+    decode(data)                               # warm the inverse compiles
+    decode(data, reduce=2)
+    sink = Metrics()
+    set_metrics_sink(sink)
+    try:
+        best_full, full = _timed(lambda: decode(data), repeats)
+        best_thumb, thumb = _timed(lambda: decode(data, reduce=2),
+                                   repeats)
+    finally:
+        set_metrics_sink(None)
+    segments = {}
+    for name, st in sink.report()["stages"].items():
+        if name.startswith("decode."):
+            entry = {"total_s": st["total_s"]}
+            for k in ("mpixels_per_s", "items_per_s", "items"):
+                if k in st:
+                    entry[k] = st[k]
+            segments[name] = entry
+    mpix = size * size / 1e6
+    t_mpix = thumb.shape[0] * thumb.shape[1] / 1e6
+    return {"value": round(mpix / best_full, 3), "unit": "MPix/s",
+            "seconds": round(best_full, 3),
+            "image": f"{size}x{size}x3 uint8 lossless",
+            "input_bytes": len(data),
+            "full_shape": list(full.shape),
+            "thumbnail": {"reduce": 2, "shape": list(thumb.shape),
+                          "seconds": round(best_thumb, 3),
+                          "value": round(t_mpix / best_thumb, 3),
+                          "speedup_vs_full": round(
+                              best_full / best_thumb, 2)},
+            "segments": segments, "repeats": repeats}
+
+
 CONFIGS = {
     "1_single_4k_rate3": config1_single_4k,
     "2_batch_2k_lossy": config2_batch_2k,
     "3_lossless_16bit": config3_lossless16,
     "4_sharded_dwt_dryrun": config4_sharded_dryrun,
     "5_mixed_upload_overlap": config5_mixed_overlap,
+    "6_decode_roundtrip": config6_decode,
 }
 
 
@@ -466,6 +557,15 @@ def main() -> int:
         try:
             results[name] = fn(repeats)
         except Exception as exc:                    # keep the scoreboard
+            if (_backend_unavailable(exc)
+                    and _REEXEC_ENV not in os.environ):
+                # Backend died at first dispatch (init-time probing
+                # passed): restart the whole sweep on CPU rather than
+                # reporting rc=1 with zero numbers (BENCH_r05).
+                print(f"# backend unavailable during {name}; "
+                      "re-exec under JAX_PLATFORMS=cpu",
+                      file=sys.stderr)
+                _reexec_on_cpu()
             results[name] = {"error": f"{type(exc).__name__}: {exc}"}
 
     entries_after = compile_cache_entries()
@@ -478,6 +578,11 @@ def main() -> int:
         "vs_baseline": round(value / BASELINE_MPIX_S, 4),
         "platform": backend["platform"],
         "n_devices": backend["n_devices"],
+        # True when this run is not on the requested accelerator: either
+        # init-time retries fell back, or a dispatch-time backend error
+        # re-exec'd the sweep onto CPU.
+        "platform_fallback": bool(backend["fallback"]
+                                  or os.environ.get(_REEXEC_ENV)),
         "backend": backend,
         "smoke": SMOKE,
         "compile_cache": {

@@ -58,10 +58,13 @@ SYNC_METHODS = {"item", "tolist", "block_until_ready",
 # batch-entry wrappers, the async-dispatch stats resolver
 # (PendingFrontend.resolve_stats — a few KB of per-block stats), the
 # CX/D stream assembly (cxd.run_cxd — pass tables + row-granular symbol
-# payload), and the mesh single-tile transform exit.
+# payload), the mesh single-tile transform exit, and the decode
+# subsystem's device->host boundary (decode.device.run_inverse — the
+# reconstructed sample batch is the decoder's product; there is nothing
+# smaller to ship).
 D2H_SANCTIONED = {"fetch_payload", "gather_rows", "run_frontend",
                   "run_tiles", "run_tiles_sharded", "resolve_stats",
-                  "run_cxd", "sharded_transform_tile"}
+                  "run_cxd", "sharded_transform_tile", "run_inverse"}
 D2H_SCOPES = ("codec", "parallel")
 
 

@@ -1,0 +1,18 @@
+"""Native JP2 decode subsystem — the inference-path mirror of the
+encoder, and the self-contained round-trip oracle (no OpenJPEG in the
+loop):
+
+- ``parser``   Tier-2: JP2 boxes, markers, packet headers (host)
+- ``t1_dec``   MQ + EBCOT context-modeling pass decode (host)
+- ``device``   dequantize + inverse DWT + inverse RCT/ICT (jitted)
+- ``decoder``  orchestration, partial decode (``reduce`` / ``layers``)
+
+Public API: :func:`decode`, :class:`DecodeError`,
+:func:`set_metrics_sink`.
+"""
+from .decoder import decode, set_metrics_sink
+from .errors import DecodeError, InvalidParam
+from .parser import probe
+
+__all__ = ["decode", "probe", "DecodeError", "InvalidParam",
+           "set_metrics_sink"]

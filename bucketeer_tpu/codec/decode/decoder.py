@@ -1,0 +1,170 @@
+"""Decode orchestration: parse -> host Tier-1 -> device inverse.
+
+The read-path mirror of ``codec/encoder.py``: Tier-2 parsing and the MQ
+pass decode stay on host (byte twiddling and an inherently serial state
+machine), the arithmetic back half (dequantize + inverse DWT + inverse
+RCT/ICT + level shift) runs as one jitted program per reconstructed tile
+shape, batched across same-shape tiles exactly like the encode pipeline.
+
+``decode(data, reduce=r)`` stops at resolution level ``r`` — Tier-1
+never touches the skipped subbands' code-blocks, which is the bulk of
+the file (JPEG 2000's resolution scalability) — and ``layers=l``
+truncates every code-block at quality layer ``l``.
+"""
+from __future__ import annotations
+
+import struct
+import time
+
+import numpy as np
+
+from ..encoder import _ceil_div
+from ..pipeline import _band_geometry
+from . import device, parser, t1_dec
+from .errors import DecodeError
+
+# Optional per-stage timing/counter sink (server.metrics.Metrics),
+# installed by the server at boot — same seam as encoder.set_metrics_sink.
+_metrics_sink = None
+
+
+def set_metrics_sink(sink) -> None:
+    """Install a metrics sink with ``record(stage, seconds, pixels=0,
+    items=0)`` and ``count(name, n=1)``. None disables."""
+    global _metrics_sink
+    _metrics_sink = sink
+
+
+def _tile_hvals(ps: parser.ParsedStream, tile: parser.DecTile,
+                reduce: int) -> tuple:
+    """Tier-1 decode one tile's kept code-blocks and assemble them into
+    (C, rh, rw) int32 half-magnitude Mallat planes. Returns
+    (planes, n_blocks, n_decisions, mq_seconds, asm_seconds)."""
+    levels_used = ps.levels - reduce
+    rh, rw = _reduced_dims(tile.th, tile.tw, reduce)
+    local = {}
+    for name, lvl, y0, x0, bh, bw in _band_geometry(rh, rw, levels_used):
+        res = 0 if name == "LL" else levels_used - lvl + 1
+        local[(res, name)] = (y0, x0, bh, bw)
+
+    specs = []
+    places = []           # (comp, local y, local x, block h, block w)
+    for c, resolutions in enumerate(tile.comp_res):
+        for res in range(levels_used + 1):
+            for band in resolutions[res]:
+                ly0, lx0, lbh, lbw = local[(res, band.name)]
+                if (lbh, lbw) != (band.by1 - band.by0,
+                                  band.bx1 - band.bx0):
+                    raise DecodeError(
+                        f"band {band.name}@r{res}: reduced geometry "
+                        "disagrees with the coded band rectangle")
+                for (cy, cx), blk in sorted(band.blocks.items()):
+                    gy0 = max(cy << ps.ycb, band.by0)
+                    gy1 = min((cy + 1) << ps.ycb, band.by1)
+                    gx0 = max(cx << ps.xcb, band.bx0)
+                    gx1 = min((cx + 1) << ps.xcb, band.bx1)
+                    specs.append((blk.data, blk.nbps, blk.npasses,
+                                  band.name, gy1 - gy0, gx1 - gx0))
+                    places.append((c, ly0 + gy0 - band.by0,
+                                   lx0 + gx0 - band.bx0))
+
+    t0 = time.perf_counter()
+    hvs, n_dec = t1_dec.decode_blocks(specs)
+    t_mq = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    planes = np.zeros((ps.n_comps, rh, rw), dtype=np.int32)
+    for (c, y, x), hv in zip(places, hvs):
+        bh, bw = hv.shape
+        planes[c, y:y + bh, x:x + bw] = hv
+    t_asm = time.perf_counter() - t0
+    return planes, len(specs), n_dec, t_mq, t_asm
+
+
+def _reduced_dims(a: int, b: int, reduce: int) -> tuple:
+    """Map a (y, x) coordinate or extent pair from the reference grid to
+    the reduced grid: ceil-divide by 2^reduce (T.800 B-15 for LL)."""
+    s = 1 << reduce
+    return _ceil_div(a, s), _ceil_div(b, s)
+
+
+def _decode_impl(data: bytes, reduce: int, layers: int | None):
+    t0 = time.perf_counter()
+    ps = parser.parse(data, reduce=reduce, layers=layers)
+    t_parse = time.perf_counter() - t0
+
+    levels_used = ps.levels - reduce
+    out_h, out_w = _reduced_dims(ps.height, ps.width, reduce)
+    out = np.zeros((out_h, out_w, ps.n_comps), dtype=np.int32)
+
+    n_blocks = n_dec = 0
+    t_mq = t_asm = 0.0
+    groups: dict = {}         # (rh, rw) -> ([planes], [(ry0, rx0)])
+    for tile in ps.tiles:
+        planes, nb, nd, tm, ta = _tile_hvals(ps, tile, reduce)
+        n_blocks += nb
+        n_dec += nd
+        t_mq += tm
+        t_asm += ta
+        y0, x0 = tile.origin
+        ry0, rx0 = _reduced_dims(y0, x0, reduce)
+        key = planes.shape[1:]
+        groups.setdefault(key, ([], []))[0].append(planes)
+        groups[key][1].append((ry0, rx0))
+
+    t0 = time.perf_counter()
+    for (rh, rw), (planes_list, origins) in groups.items():
+        def delta_of(lvl, name, _lu=levels_used):
+            res = 0 if name == "LL" else _lu - lvl + 1
+            return ps.quants[(res, name)].delta
+
+        plan = device.make_inverse_plan(
+            rh, rw, ps.n_comps, levels_used, ps.reversible, ps.bitdepth,
+            ps.used_mct, delta_of)
+        batch = np.stack(planes_list)
+        samples = device.run_inverse(plan, batch)
+        for (ry0, rx0), tile_img in zip(origins, samples):
+            out[ry0:ry0 + rh, rx0:rx0 + rw] = tile_img
+    t_dev = time.perf_counter() - t0
+
+    if _metrics_sink is not None:
+        px = ps.width * ps.height
+        _metrics_sink.record("decode.t2_parse", t_parse, pixels=px,
+                             items=ps.n_packets)
+        _metrics_sink.record("decode.mq", t_mq, items=n_dec)
+        _metrics_sink.record("decode.t1", t_asm, pixels=out_h * out_w,
+                             items=n_blocks)
+        _metrics_sink.record("decode.device_inverse", t_dev,
+                             pixels=out_h * out_w)
+        _metrics_sink.count("decode.blocks", n_blocks)
+        _metrics_sink.count("decode.mq_symbols", n_dec)
+        if ps.n_packets_skipped:
+            _metrics_sink.count("decode.packets_skipped",
+                                ps.n_packets_skipped)
+
+    dtype = np.uint8 if ps.bitdepth <= 8 else np.uint16
+    out = out.astype(dtype)
+    return out[..., 0] if ps.n_comps == 1 else out
+
+
+def decode(data: bytes, reduce: int = 0,
+           layers: int | None = None) -> np.ndarray:
+    """Decode a JP2/JPX file or raw codestream to a numpy image.
+
+    ``reduce=r`` reconstructs at 1/2^r scale from the low-frequency
+    subbands only (OpenJPEG's ``-r``); ``layers=l`` truncates at quality
+    layer ``l``. Returns (H, W) or (H, W, 3), uint8 for depths <= 8 and
+    uint16 above. Malformed or unsupported input raises
+    :class:`DecodeError` — never a raw IndexError/struct.error (the
+    explicit bounds checks are primary; the blanket catch below is the
+    contract's backstop at this trust boundary).
+    """
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise TypeError("decode() expects bytes")
+    try:
+        return _decode_impl(bytes(data), int(reduce), layers)
+    except DecodeError:
+        raise
+    except (IndexError, KeyError, ValueError, OverflowError,
+            struct.error) as exc:
+        raise DecodeError(f"malformed codestream: {exc}") from exc

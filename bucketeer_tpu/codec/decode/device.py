@@ -1,0 +1,126 @@
+"""Device-side decode back half: dequantization, multi-level inverse DWT
+and inverse RCT/ICT as one jitted XLA program per reconstructed tile
+shape — the inference-path mirror of ``pipeline._transform_batch``.
+
+The host Tier-1 decoder hands over signed half-magnitude integers
+(``t1_dec``: ``|hval| = 2*(m + 0.5) * 2^p``) assembled into the Mallat
+layout of the *reduced* tile (partial decode drops the finest
+resolutions before anything reaches the device). Dequantization is then
+uniform over the layout:
+
+- reversible (5/3): exact coefficient = ``sign * (|hval| >> 1)`` — the
+  midpoint half-bit floors away, so full lossless decodes are bit-exact
+  and truncated ones match OpenJPEG's integer reconstruction;
+- irreversible (9/7): coefficient = ``hval * (delta_b / 2)`` against a
+  static per-pixel half-step map, the decode twin of the encoder's
+  ``_step_map``.
+
+Like the encode pipeline, everything is static-shaped elementwise/concat
+work XLA fuses into a few kernels; batches of same-shape tiles share one
+program, padded to power-of-two bucket sizes to bound retraces.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...analysis import retrace
+from ...analysis.contracts import contract
+from ..dwt import dwt2d_inverse
+from ..pipeline import _band_geometry, _bucket
+from ..transforms import ict_inverse, level_shift_inverse, rct_inverse
+
+
+@dataclass(frozen=True)
+class InversePlan:
+    """Static decode plan for one reconstructed tile shape. ``slots``
+    carries (name, level, y0, x0, h, w, delta) rectangles of the reduced
+    Mallat layout — deltas are the *signaled* steps from QCD, so the
+    decoder dequantizes with exactly what the encoder quantized with."""
+    tile_h: int              # reduced tile height (after ``reduce``)
+    tile_w: int
+    n_comps: int
+    levels: int              # levels remaining after ``reduce``
+    reversible: bool
+    bitdepth: int
+    used_mct: bool
+    slots: tuple             # ((name, level, y0, x0, h, w, delta), ...)
+
+
+def make_inverse_plan(rh: int, rw: int, n_comps: int, levels: int,
+                      reversible: bool, bitdepth: int, used_mct: bool,
+                      delta_of) -> InversePlan:
+    """``delta_of(level, name) -> float`` maps a reduced-layout band to
+    its signaled quantizer step (level as in ``_band_geometry``: 1 =
+    finest of the reduced tile; the LL entry uses its own level)."""
+    slots = tuple(
+        (name, lvl, y0, x0, bh, bw, float(delta_of(lvl, name)))
+        for name, lvl, y0, x0, bh, bw in _band_geometry(rh, rw, levels))
+    return InversePlan(rh, rw, n_comps, levels, reversible, bitdepth,
+                       used_mct, slots)
+
+
+def _half_step_map(plan: InversePlan) -> np.ndarray:
+    """(h, w) float32 map of delta_b / 2 over the reduced Mallat layout
+    (hvals are in doubled units, so the half step lands on delta)."""
+    m = np.ones((plan.tile_h, plan.tile_w), dtype=np.float32)
+    for _, _, y0, x0, bh, bw, delta in plan.slots:
+        m[y0:y0 + bh, x0:x0 + bw] = delta * 0.5
+    return m
+
+
+def _inverse_body(plan: InversePlan, half_map, hv: jnp.ndarray):
+    """(B, C, h, w) int32 half-magnitudes -> (B, h, w, C) int32 samples."""
+    if plan.reversible:
+        mag = jnp.abs(hv) >> 1
+        vals = jnp.where(hv < 0, -mag, mag)
+    else:
+        vals = hv.astype(jnp.float32) * half_map
+
+    bands = [dict() for _ in range(plan.levels)]
+    ll = None
+    for name, lvl, y0, x0, bh, bw, _ in plan.slots:
+        rect = vals[..., y0:y0 + bh, x0:x0 + bw]
+        if name == "LL":
+            ll = rect
+        else:
+            bands[lvl - 1][name] = rect
+    img = dwt2d_inverse(ll, bands, plan.reversible)
+
+    x = jnp.moveaxis(img, 1, -1)                  # (B, h, w, C)
+    if plan.used_mct:
+        x = rct_inverse(x) if plan.reversible else ict_inverse(x)
+    x = level_shift_inverse(x, plan.bitdepth)
+    if not plan.reversible:
+        x = jnp.round(x)
+    x = jnp.clip(x, 0, (1 << plan.bitdepth) - 1)
+    return x.astype(jnp.int32)
+
+
+@lru_cache(maxsize=256)
+def _compiled_inverse(plan: InversePlan):
+    half_map = (None if plan.reversible
+                else jnp.asarray(_half_step_map(plan)))
+    return jax.jit(retrace.instrument(
+        "inverse", partial(_inverse_body, plan, half_map)))
+
+
+@contract(shapes={"hvals": ("B", "C", "h", "w")},
+          dtypes={"hvals": "integer"})
+def run_inverse(plan: InversePlan, hvals: np.ndarray) -> np.ndarray:
+    """Run the jitted inverse for a (B, C, h, w) int32 batch of decoded
+    tile coefficient planes; returns (B, h, w, C) int32 samples on host.
+    The batch is padded to a power-of-two bucket so a long-running read
+    service compiles O(log max-batch) programs per tile shape."""
+    b = hvals.shape[0]
+    pad = _bucket(b) - b
+    if pad:
+        hvals = np.concatenate(
+            [hvals, np.zeros((pad,) + hvals.shape[1:], hvals.dtype)])
+    fn = _compiled_inverse(plan)
+    out = fn(jnp.asarray(hvals))
+    return np.asarray(jax.device_get(out))[:b]

@@ -51,6 +51,57 @@ class BitWriter:
         return bytes(self.bytes)
 
 
+class BitReader:
+    """MSB-first bit unpacker mirroring :class:`BitWriter`: after a 0xFF
+    byte the next byte carries only 7 bits (B.10.1 bit-stuffing). Reads
+    from a buffer at an absolute position; overruns raise the caller's
+    ``overrun`` exception type so the decoder surfaces a typed error
+    instead of IndexError."""
+
+    def __init__(self, data: bytes, pos: int = 0,
+                 end: int | None = None, overrun=ValueError) -> None:
+        self.data = data
+        self.pos = pos
+        self.end = len(data) if end is None else end
+        self._overrun = overrun
+        self._acc = 0
+        self._nbits = 0
+        self._last = 0          # previously consumed byte (stuffing state)
+
+    def bit(self) -> int:
+        if self._nbits == 0:
+            if self.pos >= self.end:
+                raise self._overrun("bit stream truncated")
+            byte = self.data[self.pos]
+            self.pos += 1
+            cap = 7 if self._last == 0xFF else 8
+            if cap == 7 and byte & 0x80:
+                raise self._overrun("invalid bit-stuffing after 0xFF")
+            self._acc = byte
+            self._nbits = cap
+            self._last = byte
+        self._nbits -= 1
+        return (self._acc >> self._nbits) & 1
+
+    def bits(self, n: int) -> int:
+        v = 0
+        for _ in range(n):
+            v = (v << 1) | self.bit()
+        return v
+
+    def align(self) -> None:
+        """Byte-align after a packet header (inverse of BitWriter.flush:
+        discard padding bits; a final 0xFF is followed by a stuffed
+        byte that belongs to the header)."""
+        self._acc = 0
+        self._nbits = 0
+        if self._last == 0xFF:
+            if self.pos >= self.end:
+                raise self._overrun("bit stream truncated at stuffing")
+            self.pos += 1
+        self._last = 0
+
+
 class TagTree:
     """2-D tag tree (B.10.2): quad-tree of running minima, coded
     incrementally against rising thresholds across layers."""
@@ -112,6 +163,40 @@ class TagTree:
             self.low[lev][idx] = low
 
 
+    def decode(self, br: BitReader, x: int, y: int, threshold: int,
+               cap: int = 1 << 20):
+        """Decoder mirror of :meth:`encode`: consume bits until the
+        decoder knows whether leaf(x, y) < threshold. Returns the leaf
+        value if it is known and < threshold, else None (leaf >=
+        threshold at this point in the stream). ``cap`` bounds the value
+        a corrupt stream can grow to (each 0-bit costs one iteration)."""
+        path = []
+        for lev in range(len(self.levels)):
+            lw, _ = self.levels[lev]
+            path.append((lev, (y >> lev) * lw + (x >> lev)))
+        low = 0
+        for lev, idx in reversed(path):
+            if low > self.low[lev][idx]:
+                self.low[lev][idx] = low
+            else:
+                low = self.low[lev][idx]
+            while low < threshold:
+                if self.known[lev][idx]:
+                    break
+                if low >= cap:
+                    raise br._overrun("tag-tree value overflow")
+                if br.bit():
+                    self.value[lev][idx] = low
+                    self.known[lev][idx] = True
+                else:
+                    low += 1
+            self.low[lev][idx] = low
+        lev, idx = path[0]
+        if self.known[lev][idx] and self.value[lev][idx] < threshold:
+            return self.value[lev][idx]
+        return None
+
+
 def put_npasses(bw: BitWriter, n: int) -> None:
     """Number-of-coding-passes code (Table B.4)."""
     if n == 1:
@@ -127,6 +212,21 @@ def put_npasses(bw: BitWriter, n: int) -> None:
     else:
         bw.put_bits(0b111111111, 9)
         bw.put_bits(n - 37, 7)
+
+
+def get_npasses(br: BitReader) -> int:
+    """Inverse of :func:`put_npasses` (Table B.4)."""
+    if not br.bit():
+        return 1
+    if not br.bit():
+        return 2
+    v = br.bits(2)
+    if v < 3:
+        return 3 + v
+    w = br.bits(5)
+    if w < 31:
+        return 6 + w
+    return 37 + br.bits(7)
 
 
 @dataclass

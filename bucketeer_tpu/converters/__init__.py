@@ -12,10 +12,12 @@ correctness oracle and a no-TPU dev mode.
 from .base import Conversion, Converter, ConverterError, output_path
 from .cli import CliConverter, KakaduConverter, OpenJPEGConverter
 from .factory import available_converters, get_converter
+from .reader import TpuReader, derivative_path
 from .tpu import TpuConverter
 
 __all__ = [
     "Conversion", "Converter", "ConverterError", "output_path",
     "CliConverter", "KakaduConverter", "OpenJPEGConverter",
-    "TpuConverter", "get_converter", "available_converters",
+    "TpuConverter", "TpuReader", "derivative_path", "get_converter",
+    "available_converters",
 ]

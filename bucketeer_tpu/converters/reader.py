@@ -1,0 +1,68 @@
+"""The in-process read path: JP2/JPX derivatives back to pixels.
+
+The counterpart of :class:`TpuConverter` for the serving direction the
+reference stack exists to feed (TIFF -> JP2 -> S3 for IIIF viewers):
+IIIF tile/thumbnail requests are resolution-level reads, so the reader
+exposes the decoder's native partial decode — ``reduce=r`` touches only
+the low-frequency subbands (Tier-1 work for the skipped resolutions is
+never done), ``layers=l`` truncates at a quality layer.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..codec.decode import DecodeError, decode
+from ..codec.decode import probe as _probe
+from .base import ConverterError, output_path
+
+
+def derivative_path(image_id: str) -> str | None:
+    """Locate the stored derivative for an image id (the file
+    :class:`TpuConverter.convert` wrote): .jpx first (the default
+    output), then .jp2. None if neither exists."""
+    for ext in (".jpx", ".jp2"):
+        path = output_path(image_id, ext)
+        if os.path.exists(path):
+            return path
+    return None
+
+
+class TpuReader:
+    """JPEG 2000 decoding on the local TPU/accelerator via the JAX
+    codec — the inverse of :class:`TpuConverter`."""
+
+    name = "TPU"
+
+    def read(self, source_path: str, reduce: int = 0,
+             layers: int | None = None) -> np.ndarray:
+        """Decode a JP2/JPX file (or raw codestream) from disk.
+        Missing files raise ConverterError; malformed content raises
+        the decoder's typed DecodeError."""
+        if not os.path.exists(source_path):
+            raise ConverterError(f"derivative not found: {source_path}")
+        with open(source_path, "rb") as fh:
+            data = fh.read()
+        return decode(data, reduce=reduce, layers=layers)
+
+    def probe(self, source_path: str) -> dict:
+        """Main-header metadata (dims, bit depth, levels, layers)
+        without decoding any tile data — what the server needs to pick
+        response encodings and validate partial-decode parameters."""
+        if not os.path.exists(source_path):
+            raise ConverterError(f"derivative not found: {source_path}")
+        with open(source_path, "rb") as fh:
+            return _probe(fh.read())
+
+    def read_id(self, image_id: str, reduce: int = 0,
+                layers: int | None = None) -> np.ndarray:
+        """Decode the stored derivative for ``image_id``."""
+        path = derivative_path(image_id)
+        if path is None:
+            raise ConverterError(
+                f"no derivative for image id: {image_id}")
+        return self.read(path, reduce=reduce, layers=layers)
+
+
+__all__ = ["TpuReader", "derivative_path", "DecodeError"]

@@ -30,7 +30,8 @@ from .. import config as cfg
 from .. import constants as c
 from .. import job_factory
 from .. import models as m
-from ..converters import available_converters
+from ..codec.decode import DecodeError, InvalidParam
+from ..converters import TpuReader, available_converters, derivative_path
 from ..engine import Engine, start_job, update_item_status
 from ..engine.store import LockTimeout
 from ..engine.workers import IMAGE_WORKER
@@ -71,8 +72,11 @@ class Api:
         # it. One shared object, so app re-creation can't strand a
         # stale sink.
         self.metrics = metrics_mod.GLOBAL
+        from ..codec import decode as codec_decode
         from ..codec import encoder as codec_encoder
         codec_encoder.set_metrics_sink(self.metrics)
+        codec_decode.set_metrics_sink(self.metrics)
+        self.reader = TpuReader()
         self._background: set[asyncio.Task] = set()
         # Image-mount path prefix (reference: MainVerticle.java:92-102
         # installs it on the JobFactory at boot).
@@ -127,6 +131,55 @@ class Api:
         # 201 + JSON echo (reference: LoadImageHandler.java:73-75)
         return web.json_response(
             {c.IMAGE_ID: image_id, c.FILE_PATH: file_path}, status=201)
+
+    # --- getImage (new: the IIIF-facing read path; no reference analog,
+    # the reference only writes derivatives) ---
+    async def get_image(self, request: web.Request) -> web.Response:
+        """Decode the stored JP2/JPX derivative for an image id.
+
+        Query: ``reduce`` drops the finest resolution levels (a IIIF
+        thumbnail read — Tier-1 work for the skipped subbands never
+        happens), ``layers`` truncates at a quality layer, ``format``
+        is ``png`` (default) or ``raw`` (npy bytes for pipelines).
+        """
+        image_id = urllib.parse.unquote(request.match_info["image_id"])
+        try:
+            reduce = int(request.query.get("reduce", "0"))
+            layers = (int(request.query["layers"])
+                      if "layers" in request.query else None)
+        except ValueError:
+            return _error_page(400, "reduce/layers must be integers")
+        if reduce < 0 or (layers is not None and layers < 1):
+            return _error_page(400, "reduce must be >= 0, layers >= 1")
+        fmt = request.query.get("format", "png")
+        if fmt not in ("png", "raw"):
+            return _error_page(400, f"unknown format: {fmt}")
+        path = derivative_path(image_id)
+        if path is None:
+            return _error_page(404, f"no derivative for: {image_id}")
+        self.metrics.count("decode.requests")
+        if reduce or layers is not None:
+            self.metrics.count("decode.partial_requests")
+        try:
+            with self.metrics.time("image_read"):
+                img = await asyncio.to_thread(
+                    self.reader.read, path, reduce, layers)
+        except InvalidParam as exc:
+            # The derivative is fine; the request asked for something
+            # no stream could satisfy (e.g. reduce beyond the coded
+            # decomposition levels).
+            return _error_page(400, str(exc))
+        except DecodeError as exc:
+            LOG.warning("decode failed for %s: %s", image_id, exc)
+            self.metrics.count("decode.failures")
+            return _error_page(500, f"decode failed: {exc}")
+        bitdepth = 8
+        if img.itemsize > 1 and fmt == "png" and img.ndim == 3:
+            # PNG RGB48 is outside PIL's encoder; the downshift needs
+            # the stream's true bit depth (9..16), not a fixed >> 8.
+            bitdepth = (await asyncio.to_thread(
+                self.reader.probe, path))["bitdepth"]
+        return _image_response(img, fmt, bitdepth)
 
     # --- loadImagesFromCSV (reference: handlers/LoadCsvHandler.java:100-230) ---
     async def load_csv(self, request: web.Request) -> web.Response:
@@ -270,6 +323,32 @@ class Api:
         return web.json_response(self.metrics.report())
 
 
+def _image_response(img, fmt: str, bitdepth: int = 8) -> web.Response:
+    """Serialize a decoded array: PNG for viewers (deep RGB is
+    downshifted to 8 bits using the stream's true bit depth — PNG RGB48
+    is outside PIL's encoder), npy bytes for pipelines (exact dtype,
+    shape in headers)."""
+    import io
+
+    import numpy as np
+
+    if fmt == "raw":
+        buf = io.BytesIO()
+        np.save(buf, img)
+        return web.Response(
+            body=buf.getvalue(),
+            content_type="application/octet-stream",
+            headers={"X-Image-Shape": "x".join(map(str, img.shape)),
+                     "X-Image-Dtype": str(img.dtype)})
+    from PIL import Image
+
+    if img.dtype == np.uint16 and img.ndim == 3:
+        img = (img >> max(0, bitdepth - 8)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return web.Response(body=buf.getvalue(), content_type="image/png")
+
+
 @web.middleware
 async def error_middleware(request: web.Request, handler):
     try:
@@ -302,6 +381,7 @@ def build_app(engine: Engine,
 
     app.router.add_get("/status", api.get_status)
     app.router.add_get("/config", api.get_config)
+    app.router.add_get("/images/{image_id}", api.get_image)
     app.router.add_get("/images/{image_id}/{file_path:.+}", api.load_image)
     app.router.add_post("/batch/input/csv", api.load_csv)
     app.router.add_patch(

@@ -308,3 +308,128 @@ async def test_delete_active_job_400(tmp_path, env_client):
     await patch_task
     assert resp.status == 400
     assert "test-job" in engine.store
+
+
+# ---------- getImage (the decode read path) ----------
+
+async def test_get_image_decode_roundtrip(tmp_path, env_client,
+                                          monkeypatch):
+    """GET /images/{id}: a real encoded derivative decodes back through
+    the read endpoint — raw npy bytes are bit-exact, PNG is well-formed,
+    reduce= shrinks, and the decode.* metrics segments appear."""
+    import io
+
+    import numpy as np
+
+    from bucketeer_tpu.codec import encoder as codec_encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+    from bucketeer_tpu.converters import output_path
+
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    rng = np.random.default_rng(5)
+    img = rng.integers(0, 256, size=(48, 40)).astype(np.uint8)
+    data = codec_encoder.encode_jp2(
+        img, 8, EncodeParams(lossless=True, levels=2), jpx=True)
+    with open(output_path("ark:/9/read-me", ".jpx"), "wb") as fh:
+        fh.write(data)
+
+    client, _ = await env_client()
+    resp = await client.get("/images/ark%3A%2F9%2Fread-me?format=raw")
+    assert resp.status == 200
+    decoded = np.load(io.BytesIO(await resp.read()))
+    np.testing.assert_array_equal(decoded, img)
+    assert resp.headers["X-Image-Shape"] == "48x40"
+
+    resp = await client.get(
+        "/images/ark%3A%2F9%2Fread-me?format=raw&reduce=1")
+    reduced = np.load(io.BytesIO(await resp.read()))
+    assert reduced.shape == (24, 20)
+
+    resp = await client.get("/images/ark%3A%2F9%2Fread-me")
+    assert resp.status == 200
+    assert resp.content_type == "image/png"
+    from PIL import Image
+    png = np.asarray(Image.open(io.BytesIO(await resp.read())))
+    np.testing.assert_array_equal(png, img)
+
+    metrics = await (await client.get("/metrics")).json()
+    assert "decode.t2_parse" in metrics["stages"]
+    assert metrics["counters"]["decode.requests"] >= 3
+    assert metrics["counters"]["decode.partial_requests"] >= 1
+
+
+async def test_get_image_missing_404(env_client):
+    client, _ = await env_client()
+    resp = await client.get("/images/no-such-derivative")
+    assert resp.status == 404
+
+
+async def test_get_image_bad_params_400(tmp_path, env_client,
+                                        monkeypatch):
+    import numpy as np
+
+    from bucketeer_tpu.codec import encoder as codec_encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+    from bucketeer_tpu.converters import output_path
+
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    client, _ = await env_client()
+    assert (await client.get("/images/x?reduce=-1")).status == 400
+    assert (await client.get("/images/x?reduce=abc")).status == 400
+    assert (await client.get("/images/x?layers=0")).status == 400
+    assert (await client.get("/images/x?format=bmp")).status == 400
+    # reduce beyond the file's decomposition levels is a *client* error
+    # on a healthy derivative (400), not a corrupt-derivative 500.
+    rng = np.random.default_rng(6)
+    img = rng.integers(0, 256, size=(32, 32)).astype(np.uint8)
+    data = codec_encoder.encode_jp2(img, 8, EncodeParams(
+        lossless=True, levels=2), jpx=True)
+    with open(output_path("shallow", ".jpx"), "wb") as fh:
+        fh.write(data)
+    resp = await client.get("/images/shallow?reduce=6")
+    assert resp.status == 400
+    metrics = await (await client.get("/metrics")).json()
+    assert metrics.get("counters", {}).get("decode.failures", 0) == 0
+
+
+async def test_get_image_deep_rgb_png_downshift(tmp_path, env_client,
+                                                monkeypatch):
+    """A 12-bit RGB derivative must downshift by bitdepth-8 (=4) for
+    PNG, not a fixed 8 — a >>8 of 12-bit data renders near-black."""
+    import io
+
+    import numpy as np
+
+    from bucketeer_tpu.codec import encoder as codec_encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+    from bucketeer_tpu.converters import output_path
+
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    rng = np.random.default_rng(8)
+    img = rng.integers(3000, 4096, size=(32, 32, 3)).astype(np.uint16)
+    data = codec_encoder.encode_jp2(img, 12, EncodeParams(
+        lossless=True, levels=2), jpx=True)
+    with open(output_path("deep-rgb", ".jpx"), "wb") as fh:
+        fh.write(data)
+    client, _ = await env_client()
+    resp = await client.get("/images/deep-rgb")
+    assert resp.status == 200
+    from PIL import Image
+    png = np.asarray(Image.open(io.BytesIO(await resp.read())))
+    np.testing.assert_array_equal(png, (img >> 4).astype(np.uint8))
+
+
+async def test_get_image_corrupt_derivative_500(tmp_path, env_client,
+                                                monkeypatch):
+    """A corrupt stored derivative surfaces as a 500 with the decode
+    failure counted — the typed DecodeError, not a stack trace."""
+    from bucketeer_tpu.converters import output_path
+
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    with open(output_path("broken", ".jpx"), "wb") as fh:
+        fh.write(b"JPX!but not really")
+    client, _ = await env_client()
+    resp = await client.get("/images/broken")
+    assert resp.status == 500
+    metrics = await (await client.get("/metrics")).json()
+    assert metrics["counters"]["decode.failures"] >= 1

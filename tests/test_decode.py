@@ -1,0 +1,208 @@
+"""Native decode subsystem: the self-contained round-trip oracle.
+
+``decode(encode_jp2(img, lossless))`` must be bit-exact with *no*
+OpenJPEG in the loop — this is the correctness claim that lets the codec
+validate itself (the third-party differential tests live in
+tests/test_decode_parity.py). Pure-Python Tier-1 decode keeps image
+sizes here modest.
+"""
+import numpy as np
+import pytest
+
+from bucketeer_tpu.codec import encoder
+from bucketeer_tpu.codec.decode import DecodeError, decode
+from bucketeer_tpu.codec.encoder import EncodeParams
+
+
+def _psnr(a, b, peak=255.0):
+    mse = np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2)
+    return 10 * np.log10(peak * peak / max(mse, 1e-12))
+
+
+@pytest.mark.parametrize("shape,levels", [
+    ((32, 32), 2),
+    ((67, 93), 3),       # odd sizes exercise ceil/floor subband splits
+    ((64, 1), 2),        # zero-size HL/HH subbands
+])
+def test_lossless_gray_bit_exact(rng, shape, levels):
+    img = rng.integers(0, 256, size=shape).astype(np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(lossless=True,
+                                                   levels=levels))
+    np.testing.assert_array_equal(decode(data).reshape(shape), img)
+
+
+def test_lossless_rgb_rct_multi_tile_bit_exact(rng):
+    """The acceptance-criteria case: RGB + RCT across a real tile grid
+    (interior, right, bottom and corner tile shapes)."""
+    img = rng.integers(0, 256, size=(96, 80, 3)).astype(np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(
+        lossless=True, levels=2, tile_size=64))
+    np.testing.assert_array_equal(decode(data), img)
+
+
+def test_lossless_16bit_bit_exact(rng):
+    img = rng.integers(0, 65536, size=(64, 64)).astype(np.uint16)
+    data = encoder.encode_jp2(img, 16, EncodeParams(lossless=True,
+                                                    levels=3))
+    dec = decode(data)
+    assert dec.dtype == np.uint16
+    np.testing.assert_array_equal(dec, img)
+
+
+@pytest.mark.parametrize("prog", [0, 1, 2, 3, 4])  # LRCP..CPRL
+def test_all_progressions_decode(rng, prog):
+    img = rng.integers(0, 256, size=(96, 72, 3)).astype(np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(
+        lossless=True, levels=2, progression=prog,
+        precincts=((128, 128),)))
+    np.testing.assert_array_equal(decode(data), img)
+
+
+def test_kakadu_recipe_markers_decode(rng):
+    """The reference's structural recipe — RPCL, SOP+EPH, PLT,
+    per-resolution tile-parts, 6 layers — decodes bit-exactly through
+    our own parser (marker skipping, EPH consumption, tile-part
+    concatenation)."""
+    img = rng.integers(0, 256, size=(150, 130, 3)).astype(np.uint8)
+    params = EncodeParams.kakadu_recipe(lossless=True)
+    params.levels = 3
+    params.tile_size = 128
+    data = encoder.encode_jp2(img, 8, params)
+    assert b"\xff\x91" in data and b"\xff\x92" in data  # SOP/EPH present
+    np.testing.assert_array_equal(decode(data), img)
+
+
+def test_straddle_tile_grid_decodes(rng):
+    """Tile size 96 at 2 levels: sub-bands straddle global 64-grid
+    cells, so code-blocks are clipped to global cells — the decoder's
+    cell walk must mirror the encoder's host fallback slicing."""
+    img = rng.integers(0, 256, size=(96, 96, 3)).astype(np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(
+        lossless=True, levels=2, tile_size=96))
+    np.testing.assert_array_equal(decode(data), img)
+
+
+def test_raw_codestream_and_jpx_boxing(rng):
+    """Both containers decode: the raw .j2k codestream and the JPX
+    boxing the converter actually ships."""
+    img = rng.integers(0, 256, size=(32, 32)).astype(np.uint8)
+    params = EncodeParams(lossless=True, levels=2)
+    raw = encoder.encode_array(img, 8, params)
+    np.testing.assert_array_equal(decode(raw), img)
+    jpx = encoder.encode_jp2(img, 8, params, jpx=True)
+    np.testing.assert_array_equal(decode(jpx), img)
+
+
+def test_reduce_dims_and_nesting(rng):
+    """reduce=r yields ceil(dim / 2^r) and equals the LL content a full
+    decode's DWT would produce at that level (self-consistency of the
+    partial path, no external oracle)."""
+    img = rng.integers(0, 256, size=(67, 93)).astype(np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(lossless=True,
+                                                   levels=3))
+    for r in (0, 1, 2, 3):
+        dec = decode(data, reduce=r)
+        assert dec.shape == (-(-67 // (1 << r)), -(-93 // (1 << r)))
+    from bucketeer_tpu.codec.decode import InvalidParam
+    with pytest.raises(InvalidParam):
+        decode(data, reduce=4)       # beyond the coded levels
+    with pytest.raises(InvalidParam):
+        decode(data, layers=0)       # a layer cap below 1 is a bug,
+    assert issubclass(InvalidParam, DecodeError)   # not a clamp
+
+
+def test_reduce_skips_tier1_work(rng):
+    """The point of resolution scalability: a thumbnail decode of an
+    RPCL stream parses a fraction of the packets and decodes a fraction
+    of the MQ symbols."""
+    from bucketeer_tpu.codec.decode import decoder as dec_mod
+    from bucketeer_tpu.server.metrics import Metrics
+
+    img = rng.integers(0, 256, size=(128, 128, 3)).astype(np.uint8)
+    params = EncodeParams.kakadu_recipe(lossless=True)
+    params.levels = 3
+    params.tile_size = 128
+    data = encoder.encode_jp2(img, 8, params)
+
+    def run(**kw):
+        sink = Metrics()
+        dec_mod.set_metrics_sink(sink)
+        try:
+            decode(data, **kw)
+        finally:
+            dec_mod.set_metrics_sink(None)
+        rep = sink.report()
+        return (rep["counters"]["decode.mq_symbols"],
+                rep["stages"]["decode.t2_parse"]["items"])
+
+    syms_full, pkts_full = run()
+    syms_thumb, pkts_thumb = run(reduce=2)
+    assert syms_thumb < syms_full / 4
+    assert pkts_thumb < pkts_full
+
+
+def test_probe_reports_stream_metadata(rng):
+    from bucketeer_tpu.codec.decode import probe
+
+    img = rng.integers(0, 65536, size=(48, 40)).astype(np.uint16)
+    data = encoder.encode_jp2(img, 16, EncodeParams(
+        lossless=True, levels=3))
+    info = probe(data)
+    assert (info["width"], info["height"]) == (40, 48)
+    assert info["n_comps"] == 1 and info["bitdepth"] == 16
+    assert info["levels"] == 3 and info["reversible"] is True
+
+
+def test_layers_truncation_quality_monotonic(rng):
+    smooth = np.clip(
+        np.cumsum(np.cumsum(rng.random((96, 96)), 0), 1) / 48
+        + rng.random((96, 96)) * 20 + 90, 0, 255).astype(np.uint8)
+    data = encoder.encode_jp2(smooth, 8, EncodeParams(
+        lossless=False, levels=3, n_layers=5, rate=2.0, base_delta=0.5))
+    q1 = _psnr(decode(data, layers=1), smooth)
+    q3 = _psnr(decode(data, layers=3), smooth)
+    q5 = _psnr(decode(data, layers=5), smooth)
+    assert q1 <= q3 + 0.01 and q3 <= q5 + 0.01
+    assert q5 - q1 > 1.0, "layers carry no progressive refinement"
+
+
+def test_lossy_high_quality_roundtrip(rng):
+    base = rng.random((64, 64))
+    img = np.clip(np.cumsum(np.cumsum(base, 0), 1) / 64 + base * 30
+                  + 100, 0, 255).astype(np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(lossless=False,
+                                                   levels=3))
+    assert _psnr(decode(data), img) > 50.0
+
+
+def test_lossy_rgb_ict_roundtrip(rng):
+    y, x = np.mgrid[0:64, 0:64]
+    base = 128 + 80 * np.sin(x / 11.0) * np.cos(y / 7.0)
+    img = np.clip(base[..., None] + rng.normal(0, 6, (64, 64, 3)),
+                  0, 255).astype(np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(
+        lossless=False, levels=3, mct="on"))
+    assert _psnr(decode(data), img) > 40.0
+
+
+def test_decode_metrics_segments(rng):
+    """The decode stages report into the sink under the documented
+    segment names (the /metrics contract)."""
+    from bucketeer_tpu.codec.decode import decoder as dec_mod
+    from bucketeer_tpu.server.metrics import Metrics
+
+    img = rng.integers(0, 256, size=(32, 32)).astype(np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(lossless=True,
+                                                   levels=2))
+    sink = Metrics()
+    dec_mod.set_metrics_sink(sink)
+    try:
+        decode(data)
+    finally:
+        dec_mod.set_metrics_sink(None)
+    rep = sink.report()
+    for seg in ("decode.t2_parse", "decode.mq", "decode.t1",
+                "decode.device_inverse"):
+        assert seg in rep["stages"], seg
+    assert rep["counters"]["decode.blocks"] > 0
+    assert rep["counters"]["decode.mq_symbols"] > 0

@@ -1,0 +1,126 @@
+"""Malformed-input contract: the decoder raises the typed DecodeError —
+never IndexError / struct.error / unbounded allocation — for truncated,
+bit-flipped or garbage input (the read endpoint feeds it
+attacker-adjacent bytes straight off disk/network).
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from bucketeer_tpu.codec import encoder
+from bucketeer_tpu.codec.decode import DecodeError, decode
+from bucketeer_tpu.codec.encoder import EncodeParams
+
+
+@pytest.fixture(scope="module")
+def valid_stream():
+    rng = np.random.default_rng(99)
+    img = rng.integers(0, 256, size=(48, 40)).astype(np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(lossless=True,
+                                                   levels=2))
+    return img, data
+
+
+def _try(data: bytes):
+    """Decode arbitrary bytes; the only acceptable outcomes are a numpy
+    array or DecodeError."""
+    try:
+        out = decode(data)
+        assert isinstance(out, np.ndarray)
+        return out
+    except DecodeError:
+        return None
+
+
+def test_empty_and_garbage():
+    for junk in (b"", b"\x00", b"not a jp2 at all", b"\xff" * 64,
+                 bytes(range(256))):
+        with pytest.raises(DecodeError):
+            decode(junk)
+
+
+def test_non_bytes_rejected():
+    with pytest.raises(TypeError):
+        decode(12345)
+
+
+def test_random_prefixes(valid_stream):
+    """Every proper prefix is structurally damaged somewhere; none may
+    escape the typed error (a handful of header-only prefixes could in
+    principle decode to something — also fine, just never a raw
+    IndexError/struct.error)."""
+    _, data = valid_stream
+    rng = np.random.default_rng(7)
+    cuts = sorted(set(rng.integers(0, len(data) - 1, size=60).tolist())
+                  | {0, 1, 11, 12, 40, len(data) // 2, len(data) - 1})
+    survivors = 0
+    for cut in cuts:
+        if _try(data[:cut]) is not None:
+            survivors += 1
+    # A truncated file must essentially never decode; structural checks
+    # (EOC, tile-part lengths) catch prefixes long before packet data.
+    assert survivors == 0
+
+
+def test_random_bit_flips(valid_stream):
+    """Single-bit corruption anywhere in the file either still decodes
+    (a flipped pixel bit) or raises DecodeError — never anything else."""
+    _, data = valid_stream
+    rng = np.random.default_rng(11)
+    for _ in range(120):
+        pos = int(rng.integers(0, len(data)))
+        bit = 1 << int(rng.integers(0, 8))
+        mutated = bytearray(data)
+        mutated[pos] ^= bit
+        _try(bytes(mutated))
+
+
+def test_random_byte_stretches(valid_stream):
+    """Heavier corruption: 8-byte random stretches."""
+    _, data = valid_stream
+    rng = np.random.default_rng(13)
+    for _ in range(40):
+        pos = int(rng.integers(0, max(1, len(data) - 8)))
+        mutated = bytearray(data)
+        mutated[pos:pos + 8] = bytes(rng.integers(0, 256, 8).tolist())
+        _try(bytes(mutated))
+
+
+def test_absurd_siz_dimensions_rejected(valid_stream):
+    """A bit-flip in SIZ must trip the pixel cap, not allocate."""
+    _, data = valid_stream
+    idx = data.find(struct.pack(">H", 0xFF51))     # SIZ marker
+    assert idx > 0
+    mutated = bytearray(data)
+    # Xsiz field: marker(2) + length(2) + Rsiz(2) -> offset 6.
+    struct.pack_into(">I", mutated, idx + 6, 0x7FFFFFFF)
+    with pytest.raises(DecodeError):
+        decode(bytes(mutated))
+
+
+def test_truncated_jp2_boxes():
+    from bucketeer_tpu.codec.decode.parser import _JP2_SIG
+    with pytest.raises(DecodeError):
+        decode(_JP2_SIG)                           # signature only
+    with pytest.raises(DecodeError):
+        decode(_JP2_SIG + b"\x00\x00\x00\x99ftyp")  # box overruns EOF
+    with pytest.raises(DecodeError):               # no jp2c box at all
+        decode(_JP2_SIG + b"\x00\x00\x00\x08ftyp")
+
+
+def test_unsupported_features_are_typed_errors(valid_stream):
+    _, data = valid_stream
+    # Flip the COD transform byte to an unknown wavelet id.
+    idx = data.find(struct.pack(">H", 0xFF52))     # COD marker
+    assert idx > 0
+    mutated = bytearray(data)
+    mutated[idx + 13] = 7          # SPcod transform field
+    with pytest.raises(DecodeError):
+        decode(bytes(mutated))
+
+
+def test_valid_stream_still_decodes(valid_stream):
+    """Guard the fixture itself: the unmutated stream round-trips."""
+    img, data = valid_stream
+    np.testing.assert_array_equal(decode(data), img)
