@@ -522,6 +522,125 @@ def config6_decode(repeats: int) -> dict:
             "segments": segments, "repeats": repeats}
 
 
+def config7_concurrent_serving(repeats: int) -> dict:
+    """Concurrent serving through the cross-request encode scheduler
+    (engine/scheduler.py): N closed-loop clients, each encoding R
+    distinct same-shape images back to back, all through one shared
+    scheduler. Reports aggregate MPix/s, per-request p50/p95 latency,
+    measured device-batch occupancy (requests per merged launch), the
+    serialized 1-client x N*R baseline, and byte-identity vs the serial
+    encoder — the continuous-batching numbers the serving story stands
+    on. Env: BENCH_CLIENTS, BENCH_REQS_PER_CLIENT, BENCH_SERVE_SIZE,
+    BENCH_SCHED_SLOTS, BENCH_SCHED_WINDOW_MS."""
+    import threading
+
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+    from bucketeer_tpu.engine.scheduler import EncodeScheduler
+    from bucketeer_tpu.server.metrics import Metrics
+
+    n_clients = _env_int("BENCH_CLIENTS", 8, smoke=4)
+    per_client = _env_int("BENCH_REQS_PER_CLIENT", 3, smoke=3)
+    size = _env_int("BENCH_SERVE_SIZE", 1024, smoke=192)
+    window_s = float(os.environ.get("BENCH_SCHED_WINDOW_MS", "10")) / 1e3
+    # Encode slots: cap concurrency at roughly the host's cores — more
+    # admitted encodes than cores just thrash the GIL-bound Tier-2
+    # share; the queue (not the OS scheduler) should hold the excess.
+    slots = _env_int("BENCH_SCHED_SLOTS",
+                     max(2, min(n_clients, (os.cpu_count() or 2) - 1)))
+    imgs = [[synthetic_photo(size, seed=300 + 16 * c + k)
+             for k in range(per_client)] for c in range(n_clients)]
+    flat = [im for client_imgs in imgs for im in client_imgs]
+    params = EncodeParams(lossless=False, levels=4, base_delta=2.0,
+                          rate=3.0)
+
+    # Serialized baseline (and the byte-identity reference): one client
+    # encoding every image back to back on the plain encoder. The first
+    # encode warms the solo-batch compile; best of two passes so a
+    # noisy neighbor can't sandbag the comparison either way.
+    encoder.encode_jp2(flat[0], 8, params)
+    serial_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        serial = [encoder.encode_jp2(im, 8, params) for im in flat]
+        serial_s = min(serial_s, time.perf_counter() - t0)
+
+    sched = EncodeScheduler(max_concurrent=slots,
+                            queue_depth=2 * n_clients,
+                            window_s=window_s)
+    sink = Metrics()
+    sched.set_metrics_sink(sink)
+
+    def round_trip() -> tuple:
+        outs = [[None] * per_client for _ in range(n_clients)]
+        lats: list = []
+        errs: list = []
+        barrier = threading.Barrier(n_clients)
+
+        def client(c: int) -> None:
+            barrier.wait()
+            for k in range(per_client):
+                c0 = time.perf_counter()
+                try:
+                    outs[c][k] = sched.encode_jp2(imgs[c][k], 8, params)
+                except BaseException as exc:
+                    errs.append(exc)
+                    return
+                lats.append(time.perf_counter() - c0)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        w0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            # A silently dead client would turn a regression into a
+            # bogus-but-green data point; fail the config instead.
+            raise errs[0]
+        return time.perf_counter() - w0, outs, lats
+
+    round_trip()                 # warm the merged-bucket compiles
+    best, outs, lats = None, None, None
+    for _ in range(max(repeats, 3)):
+        wall, o, l = round_trip()
+        if best is None or wall < best:
+            best, outs, lats = wall, o, l
+    try:
+        lats_ms = sorted(x * 1e3 for x in lats)
+        rep = sink.report()
+        occ = rep.get("values", {}).get("encode.batch_occupancy",
+                                        {"count": 0, "mean": 0, "max": 0})
+        counters = rep.get("counters", {})
+        qw = rep["stages"].get("encode.queue_wait", {})
+        flat_out = [o for client_outs in outs for o in client_outs]
+        mpix = len(flat) * size * size / 1e6
+        return {
+            "value": round(mpix / best, 3), "unit": "MPix/s",
+            "seconds": round(best, 3), "clients": n_clients,
+            "requests_per_client": per_client, "slots": slots,
+            "image": f"{size}x{size}x3 uint8 rate=3",
+            "p50_ms": round(lats_ms[len(lats_ms) // 2], 1),
+            "p95_ms": round(lats_ms[min(len(lats_ms) - 1,
+                                        int(len(lats_ms) * 0.95))], 1),
+            "serialized_seconds": round(serial_s, 3),
+            "speedup_vs_serialized": round(serial_s / best, 2),
+            "occupancy": {"mean": occ["mean"], "max": occ["max"],
+                          "launches": occ["count"]},
+            "queue_wait_ms": round(
+                1e3 * qw.get("total_s", 0.0) / max(1, qw.get("count", 1)),
+                2),
+            "admission_rejects": counters.get("encode.admission_rejects",
+                                              0),
+            "byte_identical": all(a == b
+                                  for a, b in zip(serial, flat_out)),
+            "repeats": repeats,
+        }
+    finally:
+        sched.close()
+
+
 CONFIGS = {
     "1_single_4k_rate3": config1_single_4k,
     "2_batch_2k_lossy": config2_batch_2k,
@@ -529,6 +648,7 @@ CONFIGS = {
     "4_sharded_dwt_dryrun": config4_sharded_dryrun,
     "5_mixed_upload_overlap": config5_mixed_overlap,
     "6_decode_roundtrip": config6_decode,
+    "7_concurrent_serving": config7_concurrent_serving,
 }
 
 
@@ -544,6 +664,11 @@ def main() -> int:
     # and BENCH_SMOKE's own (smaller) scaling takes precedence.
     if backend["platform"] == "cpu" and not SMOKE:
         os.environ.setdefault("BENCH_BATCH_N", "4")
+        # Config 7 at accelerator defaults is minutes of CPU encode;
+        # shrink the serving load the same way.
+        os.environ.setdefault("BENCH_CLIENTS", "4")
+        os.environ.setdefault("BENCH_REQS_PER_CLIENT", "2")
+        os.environ.setdefault("BENCH_SERVE_SIZE", "512")
     repeats = _env_int(
         "BENCH_REPEATS", 3 if backend["platform"] != "cpu" else 1,
         smoke=1)

@@ -56,7 +56,9 @@ SYNC_METHODS = {"item", "tolist", "block_until_ready",
 # the sanctioned compaction gather (frontend.gather_rows, shared by the
 # packed-bitmap fetch_payload and the CX/D symbol fetch), the host
 # batch-entry wrappers, the async-dispatch stats resolver
-# (PendingFrontend.resolve_stats — a few KB of per-block stats), the
+# (PendingFrontend.resolve_stats and its once-per-launch cache
+# _host_stats, which several requests share after a merged
+# cross-request launch — a few KB of per-block stats), the
 # CX/D stream assembly (cxd.run_cxd — pass tables + row-granular symbol
 # payload), the mesh single-tile transform exit, and the decode
 # subsystem's device->host boundary (decode.device.run_inverse — the
@@ -64,7 +66,8 @@ SYNC_METHODS = {"item", "tolist", "block_until_ready",
 # smaller to ship).
 D2H_SANCTIONED = {"fetch_payload", "gather_rows", "run_frontend",
                   "run_tiles", "run_tiles_sharded", "resolve_stats",
-                  "run_cxd", "sharded_transform_tile", "run_inverse"}
+                  "_host_stats", "run_cxd", "sharded_transform_tile",
+                  "run_inverse"}
 D2H_SCOPES = ("codec", "parallel")
 
 

@@ -26,8 +26,10 @@ absent (reference: converters/ConverterFactory.java:37-47).
 """
 from __future__ import annotations
 
+import contextlib
 import math
 import os
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -88,6 +90,40 @@ def set_metrics_sink(sink) -> None:
     ``count(name, n=1)`` (server.metrics.Metrics). None disables."""
     global _metrics_sink
     _metrics_sink = sink
+
+
+# --- scheduler seam -------------------------------------------------------
+# The cross-request encode scheduler (engine/scheduler.py) routes an
+# encode's device dispatch and host Tier-1 through process-wide shared
+# resources. It installs them thread-locally around the encode call so
+# nothing about encode_array's signature or its per-request pipeline
+# logic changes: with no services installed the encoder behaves exactly
+# as before (private one-worker host executor, direct device dispatch).
+
+_SERVICES = threading.local()
+
+
+@dataclass
+class _PipelineServices:
+    dispatch: object          # callable(plan, tiles, mode=...) -> pending
+    pool: object              # shared executor; NOT shut down per encode
+    check: object = None      # callable raising on deadline/cancel
+
+
+def current_services() -> _PipelineServices | None:
+    return getattr(_SERVICES, "svc", None)
+
+
+@contextlib.contextmanager
+def pipeline_services(dispatch=None, pool=None, check=None):
+    """Install scheduler-owned pipeline services for encodes running on
+    this thread (the scheduler wraps each admitted request in this)."""
+    prev = getattr(_SERVICES, "svc", None)
+    _SERVICES.svc = _PipelineServices(dispatch, pool, check)
+    try:
+        yield
+    finally:
+        _SERVICES.svc = prev
 
 
 @dataclass
@@ -782,24 +818,45 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     use_cxd = _device_cxd(params)
     frac_bits = 0 if params.lossless else FRAC_BITS
     tm = {"device": 0.0, "host": 0.0, "cxd": 0.0, "mq": 0.0}
+    # The shared scheduler pool may run two of this encode's chunks
+    # concurrently (the private executor never did); serialize the
+    # timing accumulator so segments stay exact.
+    tm_lock = threading.Lock()
     n_syms = [0]
     floor_lam = [0.0]
     t_wall0 = time.perf_counter()
 
+    # Scheduler services (engine/scheduler.py): device dispatch routed
+    # through the process-wide batching thread and host Tier-1 on the
+    # shared pool. Absent services keep the historical private pipeline.
+    svc = current_services()
+    dispatch_fn = (svc.dispatch if svc is not None
+                   and svc.dispatch is not None
+                   else frontend.dispatch_frontend)
+
+    def _tm_add(key: str, dt: float) -> None:
+        with tm_lock:
+            tm[key] += dt
+
+    def check_deadline() -> None:
+        if svc is not None and svc.check is not None:
+            svc.check()
+
     def dispatch(chunk: _Chunk) -> None:
+        check_deadline()
         t0 = time.perf_counter()
         batch = np.stack([img[y0:y0 + chunk.plan.tile_h,
                               x0:x0 + chunk.plan.tile_w]
                           for _, y0, x0 in chunk.members])
-        chunk.pending = frontend.dispatch_frontend(
+        chunk.pending = dispatch_fn(
             chunk.plan, batch, mode="cxd" if use_cxd else "rows")
-        tm["device"] += time.perf_counter() - t0
+        _tm_add("device", time.perf_counter() - t0)
 
     def resolve(chunk: _Chunk) -> None:
         t0 = time.perf_counter()
         chunk.fres = chunk.pending.resolve_stats()
         chunk.pending = None
-        tm["device"] += time.perf_counter() - t0
+        _tm_add("device", time.perf_counter() - t0)
 
     def host_code(chunk: _Chunk, floors: np.ndarray, payload: np.ndarray,
                   offsets: np.ndarray) -> list:
@@ -811,7 +868,7 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
                                         chunk.bandnames)
         if not params.lossless:
             _correct_distortions(blocks, chunk.fres)
-        tm["host"] += time.perf_counter() - t0
+        _tm_add("host", time.perf_counter() - t0)
         return blocks
 
     def host_replay(chunk: _Chunk, streams) -> list:
@@ -822,8 +879,8 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         if not params.lossless:
             _correct_distortions(blocks, chunk.fres)
         dt = time.perf_counter() - t0
-        tm["host"] += dt
-        tm["mq"] += dt
+        _tm_add("host", dt)
+        _tm_add("mq", dt)
         return blocks
 
     def fetch_and_submit(pool, chunk: _Chunk, floors: np.ndarray,
@@ -835,8 +892,8 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
                 chunk.bandnames, chunk.hs, chunk.ws,
                 chunk.fres.layout.P, frac_bits)
             dt = time.perf_counter() - t0
-            tm["device"] += dt
-            tm["cxd"] += dt
+            _tm_add("device", dt)
+            _tm_add("cxd", dt)
             n_syms[0] += streams.total_syms
             if release_rows:
                 chunk.fres.blocks = None    # free the HBM staging buffer
@@ -844,7 +901,7 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
             src, offsets = frontend.payload_plan(chunk.fres.nbps, floors,
                                                  chunk.fres.layout.P)
             payload = frontend.fetch_payload(chunk.fres, src)
-            tm["device"] += time.perf_counter() - t0
+            _tm_add("device", time.perf_counter() - t0)
             if release_rows:
                 chunk.fres.rows = None  # free the staging buffer in HBM
         # Back-pressure: at most HOST_QUEUE_DEPTH unfinished host jobs
@@ -882,7 +939,16 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
             ofs += c.fres.n_blocks
         return out
 
-    with ThreadPoolExecutor(max_workers=1) as pool:
+    # Host Tier-1 executor: the scheduler's shared many-worker pool when
+    # one is installed (never shut down here), else the historical
+    # private one-worker executor. Reassembly stays ordered either way —
+    # results are collected in futs submission order — so output is
+    # byte-identical to the serial path.
+    if svc is not None and svc.pool is not None:
+        pool_cm = contextlib.nullcontext(svc.pool)
+    else:
+        pool_cm = ThreadPoolExecutor(max_workers=1)
+    with pool_cm as pool:
         if target is None:
             # Streaming: floors are all zero, so each chunk flows
             # dispatch -> resolve -> fetch -> host-code independently;

@@ -28,7 +28,8 @@ on TPU and on the CPU backend (no-TPU dev mode / tests).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+import threading
+from dataclasses import dataclass, field
 from functools import lru_cache, partial
 
 import jax
@@ -187,7 +188,13 @@ class FrontendResult:
     """Per tile-batch device output. ``rows`` stays on device until
     fetch_payload pulls the compacted subset. In CX/D mode (``mode=
     "cxd"``) ``rows`` is None and ``blocks`` holds the blockified int32
-    coefficient planes instead — the input of codec/cxd.py."""
+    coefficient planes instead — the input of codec/cxd.py.
+
+    ``block_base``: first block's index within the shared device
+    ``rows`` array. Non-zero when this result is one request's window
+    onto a merged cross-request launch (engine/scheduler.py) — the
+    per-block host arrays are already sliced, only the row gather needs
+    the offset (fetch_payload applies it)."""
     layout: FrontendLayout
     n_tiles: int          # real (unpadded) tiles in the batch
     rows: object          # jax array (B*n_per_tile*(P+1), 512) uint8
@@ -196,6 +203,7 @@ class FrontendResult:
     sigd: np.ndarray      # (n_blocks, P) float32
     refd: np.ndarray      # (n_blocks, P) float32
     blocks: object = None  # jax array (B*n_per_tile, 64, 64) int32
+    block_base: int = 0   # offset into the shared rows array (blocks)
 
     @property
     def n_blocks(self) -> int:
@@ -216,23 +224,46 @@ class PendingFrontend:
     rows: object          # device array, stays in HBM (None in cxd mode)
     stats: object         # device array tuple (maxidx, newsig, sigd, refd)
     blocks: object = None  # device array (cxd mode only)
+    # Host copy of ``stats``, fetched once: a merged cross-request
+    # launch (engine/scheduler.py) is resolved by several request
+    # threads, each slicing its own window.
+    _stats_np: object = None
+    _stats_lock: object = field(default_factory=threading.Lock,
+                                repr=False)
 
-    def resolve_stats(self) -> FrontendResult:
+    def _host_stats(self):
+        with self._stats_lock:
+            if self._stats_np is None:
+                self._stats_np = jax.device_get(self.stats)
+        return self._stats_np
+
+    def resolve_stats(self, tile_off: int = 0,
+                      n_tiles: int | None = None) -> FrontendResult:
         """Block for the per-block stats (a few KB) and build the
-        FrontendResult. The bitmap rows stay on device."""
-        maxidx, newsig, sigd, refd = jax.device_get(self.stats)
-        n = self.n_tiles * self.layout.n_per_tile
-        nbps = np.zeros(n, dtype=np.int32)
-        nz = maxidx[:n] > 0
+        FrontendResult. The bitmap rows stay on device.
+
+        ``tile_off``/``n_tiles`` window the result onto a contiguous
+        tile range of the batch — the seam the cross-request scheduler
+        uses to hand each request its share of a merged launch. The
+        defaults resolve the whole batch (solo launches)."""
+        maxidx, newsig, sigd, refd = self._host_stats()
+        if n_tiles is None:
+            n_tiles = self.n_tiles
+        npt = self.layout.n_per_tile
+        off = tile_off * npt
+        sl = slice(off, off + n_tiles * npt)
+        m = maxidx[sl]
+        nbps = np.zeros(n_tiles * npt, dtype=np.int32)
+        nz = m > 0
         nbps[nz] = np.floor(np.log2(
-            maxidx[:n][nz].astype(np.float64))).astype(np.int32) + 1
+            m[nz].astype(np.float64))).astype(np.int32) + 1
         # Guard-bit invariant: a magnitude above 2^Mb would make
         # payload_plan emit row indices into the next block's rows, and
         # the clamped device gather would corrupt the codestream
         # *silently*. Fail loudly like the legacy host path — a real
         # exception, not an assert, so `python -O` can't strip it.
         caps = np.tile(np.asarray(self.layout.mb_caps, dtype=np.int32),
-                       self.n_tiles)
+                       n_tiles)
         bad = nbps > caps
         if bad.any():
             raise ValueError(
@@ -240,9 +271,9 @@ class PendingFrontend:
                 f"exceeds its subband Mb "
                 f"{caps[bad][int(np.argmax(nbps[bad]))]} (coefficient "
                 "overflow in the device front-end)")
-        return FrontendResult(self.layout, self.n_tiles, self.rows, nbps,
-                              newsig[:n], sigd[:n], refd[:n],
-                              blocks=self.blocks)
+        return FrontendResult(self.layout, n_tiles, self.rows, nbps,
+                              newsig[sl], sigd[sl], refd[sl],
+                              blocks=self.blocks, block_base=off)
 
 
 @contract(shapes={"tiles": [("B", "h", "w"), ("B", "h", "w", "C")]},
@@ -338,7 +369,11 @@ def gather_rows(rows, src: np.ndarray, row_bytes: int) -> np.ndarray:
 @contract(shapes={"src": ("R",)}, dtypes={"src": "integer"})
 def fetch_payload(result: FrontendResult, src: np.ndarray) -> np.ndarray:
     """Compact the selected bitmap rows on device and copy them host-side.
-    Returns (R, 512) uint8."""
+    Returns (R, 512) uint8. ``src`` is relative to the result's own
+    first block (payload_plan output); for a window onto a merged
+    cross-request launch the shared-array offset is applied here."""
+    if result.block_base:
+        src = src + np.int64(result.block_base) * (result.layout.P + 1)
     return gather_rows(result.rows, src, ROW_BYTES)
 
 

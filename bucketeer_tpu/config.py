@@ -63,6 +63,20 @@ DEVICE_CXD = "bucketeer.tpu.device.cxd"
 # reuse compiled XLA programs instead of recompiling at boot. Env analog:
 # BUCKETEER_COMPILE_CACHE (converters/tpu.py wires both).
 COMPILE_CACHE = "bucketeer.tpu.compile.cache"
+# Cross-request encode scheduler (engine/scheduler.py): admission bound
+# (queued + running requests before 503), encode slots, shared host
+# Tier-1 pool size, device-batching aggregation window, and the default
+# per-request deadline (0 = none). Each also has a BUCKETEER_SCHED_*
+# env analog read by the scheduler itself.
+SCHED_QUEUE_DEPTH = "bucketeer.sched.queue.depth"
+SCHED_MAX_CONCURRENT = "bucketeer.sched.max.concurrent"
+SCHED_POOL_SIZE = "bucketeer.sched.pool.size"
+SCHED_WINDOW_MS = "bucketeer.sched.window.ms"
+SCHED_DEADLINE_S = "bucketeer.sched.deadline.s"
+# Decoded-image LRU cache budget for the GET /images read path, in MB
+# (converters/reader.py; 0 disables). Env analog by the standard
+# overlay: BUCKETEER_DECODE_CACHE_MB.
+DECODE_CACHE_MB = "bucketeer.decode.cache.mb"
 
 # Every known key (env overlay applies to these even without defaults).
 ALL_KEYS = (
@@ -75,6 +89,8 @@ ALL_KEYS = (
     SLACK_CHANNEL_ID, SLACK_ERROR_CHANNEL_ID, SLACK_WEBHOOK_URL,
     FEATURE_FLAGS, TPU_LOSSY_RATE, TPU_BATCH_SIZE, TPU_MESH_SHAPE,
     MESH_MIN_PIXELS, CONVERSION_TYPE, DEVICE_CXD, COMPILE_CACHE,
+    SCHED_QUEUE_DEPTH, SCHED_MAX_CONCURRENT, SCHED_POOL_SIZE,
+    SCHED_WINDOW_MS, SCHED_DEADLINE_S, DECODE_CACHE_MB,
 )
 
 _DEFAULTS: dict[str, Any] = {

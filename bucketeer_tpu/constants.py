@@ -25,6 +25,9 @@ COUNT = "count"
 JOBS = "jobs"
 REMAINING = "remaining"
 NOTHING_PROCESSED = "nothing-processed"
+# Admission backpressure: seconds-to-wait hint carried in a 503 reply
+# body (engine/scheduler.py QueueFull -> HTTP Retry-After header).
+RETRY_AFTER = "retry-after"
 BATCH_RESPONSE = "batch-response"
 S3_BUCKET = "bucket"
 

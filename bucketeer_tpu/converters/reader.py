@@ -6,16 +6,28 @@ IIIF tile/thumbnail requests are resolution-level reads, so the reader
 exposes the decoder's native partial decode — ``reduce=r`` touches only
 the low-frequency subbands (Tier-1 work for the skipped resolutions is
 never done), ``layers=l`` truncates at a quality layer.
+
+Repeated reads of the same derivative (viewers re-request thumbnails
+constantly) are served from a small bounded LRU keyed by
+``(path, mtime, size, reduce, layers)`` — the file-identity part of the
+key means a re-converted derivative is never served stale. Budget:
+``BUCKETEER_DECODE_CACHE_MB`` (default 64, 0 disables); hits/misses/
+evictions surface as ``decode.cache_hits`` / ``decode.cache_misses`` /
+``decode.cache_evictions`` counters when a metrics sink is attached.
 """
 from __future__ import annotations
 
 import os
+import threading
+from collections import OrderedDict
 
 import numpy as np
 
 from ..codec.decode import DecodeError, decode
 from ..codec.decode import probe as _probe
 from .base import ConverterError, output_path
+
+DEFAULT_CACHE_MB = 64
 
 
 def derivative_path(image_id: str) -> str | None:
@@ -29,22 +41,107 @@ def derivative_path(image_id: str) -> str | None:
     return None
 
 
+class _DecodeCache:
+    """Bounded LRU of decoded arrays, sized in bytes. Entries are
+    returned write-locked (``setflags(write=False)``) so a caller
+    mutating a cached array fails loudly instead of corrupting every
+    later hit."""
+
+    def __init__(self, max_bytes: int) -> None:
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        self._bytes = 0
+        self.evictions = 0
+
+    def get(self, key):
+        with self._lock:
+            arr = self._entries.get(key)
+            if arr is not None:
+                self._entries.move_to_end(key)
+            return arr
+
+    def put(self, key, arr: np.ndarray) -> int:
+        """Insert and evict LRU entries past the budget. Returns how
+        many entries *this* call evicted (computed under the lock, so
+        concurrent misses don't count each other's evictions)."""
+        if arr.nbytes > self.max_bytes:
+            return 0                    # bigger than the whole budget
+        arr.setflags(write=False)
+        evicted_here = 0
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = arr
+            self._bytes += arr.nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.nbytes
+                self.evictions += 1
+                evicted_here += 1
+        return evicted_here
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+
 class TpuReader:
     """JPEG 2000 decoding on the local TPU/accelerator via the JAX
-    codec — the inverse of :class:`TpuConverter`."""
+    codec — the inverse of :class:`TpuConverter`.
+
+    ``cache_mb``: decoded-image LRU budget; negative resolves the
+    BUCKETEER_DECODE_CACHE_MB env (default 64), 0 disables. ``metrics``:
+    optional server.metrics.Metrics-like sink for the cache counters.
+    """
 
     name = "TPU"
+
+    def __init__(self, cache_mb: int = -1, metrics=None) -> None:
+        if cache_mb < 0:
+            try:
+                cache_mb = int(os.environ.get("BUCKETEER_DECODE_CACHE_MB",
+                                              str(DEFAULT_CACHE_MB)))
+            except ValueError:
+                cache_mb = DEFAULT_CACHE_MB
+        self.cache = (_DecodeCache(cache_mb << 20) if cache_mb > 0
+                      else None)
+        self.metrics = metrics
+
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.count(name)
 
     def read(self, source_path: str, reduce: int = 0,
              layers: int | None = None) -> np.ndarray:
         """Decode a JP2/JPX file (or raw codestream) from disk.
         Missing files raise ConverterError; malformed content raises
-        the decoder's typed DecodeError."""
-        if not os.path.exists(source_path):
-            raise ConverterError(f"derivative not found: {source_path}")
+        the decoder's typed DecodeError. Cache hits return a read-only
+        array — copy before mutating."""
+        try:
+            st = os.stat(source_path)
+        except OSError:
+            raise ConverterError(
+                f"derivative not found: {source_path}") from None
+        key = (source_path, st.st_mtime_ns, st.st_size, reduce, layers)
+        if self.cache is not None:
+            img = self.cache.get(key)
+            if img is not None:
+                self._count("decode.cache_hits")
+                return img
+            self._count("decode.cache_misses")
         with open(source_path, "rb") as fh:
             data = fh.read()
-        return decode(data, reduce=reduce, layers=layers)
+        img = decode(data, reduce=reduce, layers=layers)
+        if self.cache is not None:
+            evicted = self.cache.put(key, img)
+            if evicted and self.metrics is not None:
+                self.metrics.count("decode.cache_evictions", evicted)
+        return img
 
     def probe(self, source_path: str) -> dict:
         """Main-header metadata (dims, bit depth, levels, layers)

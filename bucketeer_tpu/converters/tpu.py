@@ -111,7 +111,8 @@ class TpuConverter:
                  jpx: bool = True,
                  mesh_min_pixels: int | None = None,
                  device_cxd: bool | None = None,
-                 compile_cache: str | None = None) -> None:
+                 compile_cache: str | None = None,
+                 scheduler=None) -> None:
         self.lossy_rate = lossy_rate
         self.jpx = jpx
         self.mesh_min_pixels = (_env_mesh_min_pixels()
@@ -121,6 +122,12 @@ class TpuConverter:
         # (encoder._device_cxd); the engine wires the
         # bucketeer.tpu.device.cxd config key through here.
         self.device_cxd = device_cxd
+        # Encodes go through the cross-request scheduler (admission
+        # control + continuous device batching + shared host Tier-1).
+        # None = the process-wide instance, resolved lazily per convert
+        # (engine/scheduler.py imports converters back — a boot-time
+        # import here would cycle).
+        self.scheduler = scheduler
         maybe_enable_compile_cache(compile_cache)
 
     def _choose_mesh(self, h: int, w: int, params: EncodeParams):
@@ -150,7 +157,21 @@ class TpuConverter:
         return make_mesh(devices, tile_parallel=1)
 
     def convert(self, image_id: str, source_path: str,
-                conversion: Conversion = Conversion.LOSSLESS) -> str:
+                conversion: Conversion = Conversion.LOSSLESS,
+                priority: int | None = None,
+                deadline_s: float | None = None) -> str:
+        """Convert one source image to a JP2/JPX derivative.
+
+        ``priority``: scheduler queue class — engine/scheduler.py
+        PRIORITY_SINGLE (default, interactive requests) or
+        PRIORITY_BATCH (CSV items; the batch worker passes it so
+        interactive traffic jumps the queue). ``deadline_s`` bounds the
+        request end to end; expiry raises through as a typed scheduler
+        error. Raises ``QueueFull`` (503 + Retry-After upstream) when
+        the scheduler's bounded queue is at depth.
+        """
+        from ..engine import scheduler as sched_mod
+
         if not os.path.exists(source_path):
             raise ConverterError(f"source not found: {source_path}")
         try:
@@ -176,9 +197,17 @@ class TpuConverter:
         if mesh is not None:
             LOG.info("routing %s (%dx%d) through the device mesh %s",
                      image_id, w, h, dict(mesh.shape))
+        sched = self.scheduler or sched_mod.get_scheduler()
         try:
-            data = encode_jp2(img, bitdepth, params, jpx=self.jpx,
-                              mesh=mesh)
+            data = sched.encode_jp2(
+                img, bitdepth, params, jpx=self.jpx, mesh=mesh,
+                priority=(sched_mod.PRIORITY_SINGLE if priority is None
+                          else priority),
+                deadline_s=deadline_s)
+        except (sched_mod.QueueFull, sched_mod.DeadlineExceeded):
+            # Admission/deadline outcomes are protocol, not converter
+            # failures: the HTTP layer maps them to 503 + Retry-After.
+            raise
         except Exception as exc:
             raise ConverterError(
                 f"encode failed for {image_id}: {exc}") from exc

@@ -9,6 +9,8 @@ from .bus import BusError, MessageBus, Reply
 from .core import Engine
 from .s3 import (FakeS3Client, HttpS3Client, S3_UPLOADER, S3Error,
                  S3UploadWorker, S3UploaderConfig)
+from .scheduler import (PRIORITY_BATCH, PRIORITY_SINGLE, DeadlineExceeded,
+                        EncodeScheduler, QueueFull, get_scheduler)
 from .slack import HttpSlackClient, RecordingSlackClient, SlackWorker
 from .store import Counters, JobStore, LockTimeout, UploadsMap
 from .workers import (FESTER, FINALIZE_JOB, IMAGE_WORKER, ITEM_FAILURE,
@@ -26,4 +28,6 @@ __all__ = [
     "LargeImageWorker", "FesterWorker", "update_item_status",
     "IMAGE_WORKER", "ITEM_FAILURE", "FINALIZE_JOB", "LARGE_IMAGE", "FESTER",
     "BatchConverterWorker", "BATCH_CONVERTER", "start_job",
+    "EncodeScheduler", "get_scheduler", "QueueFull", "DeadlineExceeded",
+    "PRIORITY_SINGLE", "PRIORITY_BATCH",
 ]

@@ -15,6 +15,7 @@ and a real Lambda PATCHes statuses back.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import os
 
@@ -25,6 +26,7 @@ from ..converters import Conversion, ConverterError
 from ..models import Job, WorkflowState
 from .bus import MessageBus, Reply
 from .s3 import S3_UPLOADER
+from .scheduler import PRIORITY_BATCH, DeadlineExceeded, QueueFull
 from .store import JobStore, LockTimeout
 from .workers import (FINALIZE_JOB, ITEM_FAILURE, LARGE_IMAGE,
                       update_item_status)
@@ -79,9 +81,17 @@ class BatchConverterWorker:
         conversion = Conversion(
             message.get(c.CONVERSION_TYPE)
             or self.config.get_str(cfg.CONVERSION_TYPE) or "lossless")
+        # Batch items yield to interactive single-image traffic in the
+        # encode scheduler's slot queue; only converters that know the
+        # scheduler take the kwarg (the stub/CLI ones don't).
+        kwargs = {}
+        if "priority" in inspect.signature(
+                self.converter.convert).parameters:
+            kwargs["priority"] = PRIORITY_BATCH
         try:
             derivative = await asyncio.to_thread(
-                self.converter.convert, image_id, file_path, conversion)
+                self.converter.convert, image_id, file_path, conversion,
+                **kwargs)
             reply = await self.bus.request_with_retry(S3_UPLOADER, {
                 c.IMAGE_ID: os.path.basename(derivative),
                 c.FILE_PATH: derivative,
@@ -89,6 +99,15 @@ class BatchConverterWorker:
                 c.DERIVATIVE_IMAGE: True,
             })
             ok = reply.is_success
+        except QueueFull as exc:
+            # Encode-queue backpressure is transient by definition: the
+            # bus's retry protocol requeues the item after a delay
+            # instead of failing it (the reference's S3 semantics).
+            LOG.warning("encode queue full for %s: %s", image_id, exc)
+            return Reply.retry()
+        except DeadlineExceeded as exc:
+            LOG.error("batch item %s missed its encode deadline: %s",
+                      image_id, exc)
         except ConverterError as exc:
             LOG.error("batch convert failed for %s: %s", image_id, exc)
         except Exception as exc:

@@ -38,6 +38,23 @@ class Engine:
         self.s3_client = s3_client or make_s3_client(self.config)
         self.slack_client = slack_client or make_slack_client(self.config)
 
+        # Cross-request encode scheduler: one process-wide instance
+        # shared by the single-image and batch paths, tuned by the
+        # bucketeer.sched.* keys (0/absent keeps the scheduler's
+        # env-or-built-in defaults).
+        from .scheduler import get_scheduler
+        self.scheduler = get_scheduler()
+        self.scheduler.configure(
+            queue_depth=self.config.get_int(cfg.SCHED_QUEUE_DEPTH, 0)
+            or None,
+            max_concurrent=self.config.get_int(cfg.SCHED_MAX_CONCURRENT,
+                                               0) or None,
+            pool_size=self.config.get_int(cfg.SCHED_POOL_SIZE, 0) or None,
+            window_s=(self.config.get_float(cfg.SCHED_WINDOW_MS, 0)
+                      / 1000.0) or None,
+            deadline_s=self.config.get_float(cfg.SCHED_DEADLINE_S, 0)
+            or None)
+
         self.bus = MessageBus(
             retry_delay=self.config.get_float(cfg.S3_REQUEUE_DELAY))
         self.store = JobStore()
@@ -75,7 +92,14 @@ class Engine:
         if threads <= 0:
             threads = max(1, (os.cpu_count() or 2) - 1)
         self.s3_worker.register(self.bus, instances=instances * threads)
-        self.image_worker.register(self.bus)
+        # More than one consumer so concurrent single-image requests
+        # actually reach the encode scheduler together (it, not the bus
+        # queue, owns concurrency control and backpressure now); the
+        # reference's one single-threaded image worker is restored with
+        # image.worker.instances=1.
+        self.image_worker.register(
+            self.bus,
+            instances=self.config.get_int("image.worker.instances", 4))
         self.batch_worker.register(
             self.bus, instances=self.config.get_int("batch.converter.instances", 2))
         self.item_failure.register(self.bus)

@@ -1,0 +1,521 @@
+"""Cross-request encode scheduler: continuous device batching + a shared
+multi-threaded host Tier-1 pool.
+
+Before this module every encode request ran a private pipeline:
+``encode_array`` spun up its own one-worker executor for host Tier-1 and
+dispatched device programs with no coordination across requests, so two
+concurrent ``load_image`` calls contended for the device, serialized
+their MQ replay on single host threads, and re-paid dispatch overhead
+per chunk. The scheduler is the process-wide service that owns device
+access and host Tier-1 capacity instead:
+
+- **Device batching** — concurrent encodes submit their chunks here
+  rather than dispatching directly. A single device thread owns all
+  front-end launches; compatible chunks from *different* requests (same
+  tile plan, mode, dtype) are concatenated into one launch, padded to
+  the existing power-of-two batch buckets (pipeline._bucket) so jitted
+  programs are reused, not retraced. Each request gets back a sliced
+  view of the merged result — per-tile results are bit-identical to a
+  solo launch because every front-end reduction is within-tile.
+  CX/D-mode chunks (``BUCKETEER_DEVICE_CXD``) are not merged — their
+  blockified coefficients stay HBM-resident for a separate device stage
+  whose program is shaped per chunk — but they still flow through the
+  same device thread and host pool.
+- **Shared host Tier-1** — MQ replay / packed Tier-1 runs on one pool
+  sized to host cores (``t1_encode_cxd``/``t1_encode_packed`` release
+  the GIL, proven in tests/test_native_t1.py), with per-request ordered
+  reassembly: each request collects its own futures in submission
+  order, so output stays byte-identical to the serial path.
+- **Admission control** — a bounded queue with backpressure: when
+  waiting+running requests exceed the depth, ``submit`` raises
+  :class:`QueueFull` and the HTTP layer answers 503 with
+  ``Retry-After``. Single-image requests are prioritized over batch
+  items, and each request can carry a deadline that expires both while
+  queued and at chunk-dispatch boundaries.
+
+Observability (``set_metrics_sink``): ``encode.queue_wait`` (stage),
+``encode.batch_occupancy`` (value distribution: requests per device
+launch), and counters ``encode.admission_rejects``,
+``encode.device_launches``, ``encode.batched_tiles``,
+``encode.deadline_expired``.
+
+The pipeline-mapping trade-off this implements — shared replicated
+workers per stage versus per-request pipelines, throughput vs latency —
+is the bi-criteria mapping problem of PAPERS.md (arxiv 0801.1772);
+continuous batching on the device axis is the same shape LLM serving
+stacks use.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+LOG = logging.getLogger(__name__)
+
+PRIORITY_SINGLE = 0      # interactive single-image requests
+PRIORITY_BATCH = 1       # CSV batch items yield to interactive traffic
+
+# Upper bound on tiles per merged device launch: keeps the padded HBM
+# staging (rows buffers) bounded however many requests pile up.
+_MAX_BATCH_TILES = int(os.environ.get("BUCKETEER_SCHED_MAX_BATCH_TILES",
+                                      "64"))
+
+
+class QueueFull(RuntimeError):
+    """Admission rejected: the bounded request queue is at depth. The
+    HTTP layer maps this to 503 + ``Retry-After: retry_after``."""
+
+    def __init__(self, depth: int, retry_after: float) -> None:
+        self.retry_after = retry_after
+        super().__init__(
+            f"encode queue full ({depth} requests queued or running); "
+            f"retry after {retry_after:g}s")
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired before (or while) encoding."""
+
+
+@dataclass
+class _Ticket:
+    """One admitted request's place in the slot queue."""
+    priority: int
+    seq: int
+    deadline: float | None            # absolute time.monotonic()
+    granted: threading.Event = field(default_factory=threading.Event)
+    abandoned: bool = False           # expired while waiting
+    closed: bool = False
+
+    def expired(self) -> bool:
+        return (self.deadline is not None
+                and time.monotonic() > self.deadline)
+
+
+@dataclass
+class _DeviceJob:
+    """One chunk's front-end launch request."""
+    plan: object
+    tiles: np.ndarray
+    mode: str
+    n_tiles: int
+    event: threading.Event = field(default_factory=threading.Event)
+    result: object = None
+    error: BaseException | None = None
+
+    @property
+    def key(self):
+        # Merge-compatibility: identical jitted program + concatenable
+        # host batch. "rows" only — cxd launches are shaped per chunk.
+        return (self.plan, self.mode, self.tiles.dtype.str,
+                self.tiles.shape[1:])
+
+
+@dataclass
+class _SlicedPending:
+    """A request's share of a merged front-end launch: quacks like
+    frontend.PendingFrontend (resolve_stats) but resolves to a
+    FrontendResult windowed onto [tile_off, tile_off + n_tiles)."""
+    merged: object            # frontend.PendingFrontend
+    tile_off: int
+    n_tiles: int
+
+    def resolve_stats(self):
+        return self.merged.resolve_stats(tile_off=self.tile_off,
+                                         n_tiles=self.n_tiles)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class EncodeScheduler:
+    """Process-wide encode service: admission -> slot -> pipelined
+    encode with scheduler-owned device dispatch and host pool.
+
+    Defaults (env-overridable):
+
+    - ``BUCKETEER_SCHED_QUEUE_DEPTH`` (32): admission bound, queued +
+      running requests.
+    - ``BUCKETEER_SCHED_MAX_CONCURRENT`` (8): encode slots; beyond
+      this, admitted requests wait (by priority, then FIFO).
+    - ``BUCKETEER_SCHED_POOL`` (host cores): shared Tier-1 workers.
+    - ``BUCKETEER_SCHED_WINDOW_MS`` (3): aggregation window the device
+      thread waits for co-batchable chunks while other requests are in
+      flight. 0 disables merging.
+    - ``BUCKETEER_SCHED_DEADLINE_S`` (0 = none): default per-request
+      deadline.
+    - ``BUCKETEER_SCHED_RETRY_AFTER_S`` (2): the Retry-After hint
+      attached to :class:`QueueFull`.
+    """
+
+    def __init__(self, *, queue_depth: int | None = None,
+                 max_concurrent: int | None = None,
+                 pool_size: int | None = None,
+                 window_s: float | None = None,
+                 deadline_s: float | None = None,
+                 retry_after_s: float | None = None) -> None:
+        cores = os.cpu_count() or 2
+        self.queue_depth = queue_depth if queue_depth is not None else \
+            _env_int("BUCKETEER_SCHED_QUEUE_DEPTH", 32)
+        self.max_concurrent = max_concurrent if max_concurrent is not \
+            None else _env_int("BUCKETEER_SCHED_MAX_CONCURRENT", 8)
+        self.pool_size = pool_size if pool_size is not None else \
+            _env_int("BUCKETEER_SCHED_POOL", cores)
+        if window_s is not None:
+            self.window_s = window_s
+        else:
+            self.window_s = _env_float("BUCKETEER_SCHED_WINDOW_MS",
+                                       3.0) / 1000.0
+        if deadline_s is not None:
+            self.default_deadline_s = deadline_s or None
+        else:
+            self.default_deadline_s = _env_float(
+                "BUCKETEER_SCHED_DEADLINE_S", 0.0) or None
+        self.retry_after_s = retry_after_s if retry_after_s is not None \
+            else _env_float("BUCKETEER_SCHED_RETRY_AFTER_S", 2.0)
+
+        self._pool = ThreadPoolExecutor(max_workers=max(1, self.pool_size),
+                                        thread_name_prefix="sched-t1")
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._waiting: list = []      # heap of (priority, seq, ticket)
+        self._running = 0
+        self._admitted = 0            # waiting + running
+        self._sink = None
+
+        self._dq_cv = threading.Condition()
+        self._djobs: deque = deque()
+        self._device_thread: threading.Thread | None = None
+        self._stop = False
+
+    # -- metrics ------------------------------------------------------
+
+    def set_metrics_sink(self, sink) -> None:
+        """Install a server.metrics.Metrics-like sink (``record``,
+        ``observe``, ``count``); None disables."""
+        self._sink = sink
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self._sink is not None:
+            self._sink.count(name, n)
+
+    # -- configuration -------------------------------------------------
+
+    def configure(self, *, queue_depth: int | None = None,
+                  max_concurrent: int | None = None,
+                  pool_size: int | None = None,
+                  window_s: float | None = None,
+                  deadline_s: float | None = None) -> None:
+        """Apply deployment config (engine/core.py wires the
+        ``bucketeer.sched.*`` keys through here). Resizing the pool
+        swaps executors; in-flight jobs finish on the old one."""
+        with self._lock:
+            if queue_depth is not None and queue_depth > 0:
+                self.queue_depth = queue_depth
+            if max_concurrent is not None and max_concurrent > 0:
+                self.max_concurrent = max_concurrent
+                self._grant_next_locked()
+            if window_s is not None and window_s >= 0:
+                self.window_s = window_s
+            if deadline_s is not None:
+                self.default_deadline_s = deadline_s or None
+            if pool_size is not None and pool_size > 0 and \
+                    pool_size != self.pool_size:
+                old = self._pool
+                self.pool_size = pool_size
+                self._pool = ThreadPoolExecutor(
+                    max_workers=pool_size, thread_name_prefix="sched-t1")
+                # In-flight encodes captured the old pool at admission
+                # and will still submit to it; shutting it down under
+                # them would turn their next chunk into a RuntimeError.
+                # Only close it when nothing is running — otherwise its
+                # idle threads wind down at interpreter exit.
+                if self._admitted == 0:
+                    old.shutdown(wait=False)
+
+    # -- admission + slots ---------------------------------------------
+
+    def _admit(self, priority: int, deadline_s: float | None) -> _Ticket:
+        with self._lock:
+            if self._admitted >= self.queue_depth:
+                self._count("encode.admission_rejects")
+                raise QueueFull(self.queue_depth, self.retry_after_s)
+            self._admitted += 1
+            if deadline_s is None:
+                deadline_s = self.default_deadline_s
+            deadline = (time.monotonic() + deadline_s
+                        if deadline_s else None)
+            t = _Ticket(priority, next(self._seq), deadline)
+            if self._running < self.max_concurrent and not self._waiting:
+                self._running += 1
+                t.granted.set()
+            else:
+                heapq.heappush(self._waiting, (priority, t.seq, t))
+            return t
+
+    def _grant_next_locked(self) -> None:
+        while self._waiting and self._running < self.max_concurrent:
+            _, _, t = heapq.heappop(self._waiting)
+            if t.abandoned or t.closed:
+                continue
+            self._running += 1
+            t.granted.set()
+
+    def _await_slot(self, t: _Ticket) -> None:
+        t0 = time.perf_counter()
+        while not t.granted.is_set():
+            timeout = None
+            if t.deadline is not None:
+                timeout = t.deadline - time.monotonic()
+                if timeout <= 0:
+                    with self._lock:
+                        t.abandoned = True
+                    self._count("encode.deadline_expired")
+                    raise DeadlineExceeded(
+                        "encode deadline expired while queued")
+            t.granted.wait(timeout)
+        if self._sink is not None:
+            self._sink.record("encode.queue_wait",
+                              time.perf_counter() - t0)
+
+    def _finish(self, t: _Ticket) -> None:
+        with self._lock:
+            if t.closed:
+                return
+            t.closed = True
+            self._admitted -= 1
+            if t.granted.is_set():
+                self._running -= 1
+                self._grant_next_locked()
+
+    # -- the public encode surface -------------------------------------
+
+    def submit(self, fn, *args, priority: int = PRIORITY_SINGLE,
+               deadline_s: float | None = None, **kwargs):
+        """Run ``fn(*args, **kwargs)`` as one admitted encode request:
+        wait for a slot (by priority, bounded by the deadline), then
+        execute with the encoder's device dispatch and host Tier-1
+        routed through this scheduler. Raises :class:`QueueFull`
+        without blocking when the bounded queue is at depth."""
+        from ..codec import encoder as encoder_mod
+
+        ticket = self._admit(priority, deadline_s)
+
+        def check() -> None:
+            """Deadline hook the encoder polls at chunk-dispatch
+            boundaries (codec/encoder.py pipeline_services)."""
+            if ticket.expired():
+                self._count("encode.deadline_expired")
+                raise DeadlineExceeded(
+                    "encode deadline expired mid-pipeline")
+
+        try:
+            self._await_slot(ticket)
+            with encoder_mod.pipeline_services(
+                    dispatch=self.dispatch_frontend, pool=self._pool,
+                    check=check):
+                return fn(*args, **kwargs)
+        finally:
+            self._finish(ticket)
+
+    def encode_array(self, img, bitdepth: int = 8, params=None,
+                     mesh=None, *, priority: int = PRIORITY_SINGLE,
+                     deadline_s: float | None = None) -> bytes:
+        from ..codec import encoder as encoder_mod
+
+        return self.submit(encoder_mod.encode_array, img, bitdepth,
+                           params, mesh=mesh, priority=priority,
+                           deadline_s=deadline_s)
+
+    def encode_jp2(self, img, bitdepth: int = 8, params=None,
+                   jpx: bool = False, mesh=None, *,
+                   priority: int = PRIORITY_SINGLE,
+                   deadline_s: float | None = None) -> bytes:
+        from ..codec import encoder as encoder_mod
+
+        return self.submit(encoder_mod.encode_jp2, img, bitdepth,
+                           params, jpx=jpx, mesh=mesh, priority=priority,
+                           deadline_s=deadline_s)
+
+    # -- device batching -----------------------------------------------
+
+    def dispatch_frontend(self, plan, tiles, mode: str = "rows"):
+        """The encoder's device-dispatch hook: queue a front-end launch
+        and block until the device thread has dispatched it (the
+        launch itself stays async — JAX returns before the program
+        finishes). Compatible queued chunks are merged into one
+        launch; the caller gets its slice."""
+        self._ensure_device_thread()
+        job = _DeviceJob(plan, np.asarray(tiles), mode, len(tiles))
+        with self._dq_cv:
+            if self._stop:
+                raise RuntimeError("scheduler is closed")
+            self._djobs.append(job)
+            self._dq_cv.notify_all()
+        job.event.wait()
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    def _ensure_device_thread(self) -> None:
+        with self._dq_cv:
+            if self._device_thread is None or \
+                    not self._device_thread.is_alive():
+                self._stop = False
+                self._device_thread = threading.Thread(
+                    target=self._device_loop, name="sched-device",
+                    daemon=True)
+                self._device_thread.start()
+
+    def _take_compatible(self, group: list) -> int:
+        """Move queued jobs merge-compatible with group[0] into the
+        group (caller holds the queue cv). Returns group tile total."""
+        key = group[0].key
+        total = sum(j.n_tiles for j in group)
+        kept: deque = deque()
+        while self._djobs:
+            j = self._djobs.popleft()
+            if j.mode == "rows" and j.key == key and \
+                    total + j.n_tiles <= _MAX_BATCH_TILES:
+                group.append(j)
+                total += j.n_tiles
+            else:
+                kept.append(j)
+        self._djobs = kept
+        return total
+
+    def _device_loop(self) -> None:
+        while True:
+            with self._dq_cv:
+                while not self._djobs and not self._stop:
+                    self._dq_cv.wait()
+                if self._stop:
+                    for j in self._djobs:
+                        j.error = RuntimeError("scheduler closed")
+                        j.event.set()
+                    self._djobs.clear()
+                    return
+                group = [self._djobs.popleft()]
+                if group[0].mode == "rows" and self.window_s > 0:
+                    # Continuous batching: wait up to the window for
+                    # co-batchable chunks while other running requests
+                    # could still contribute one.
+                    limit = time.monotonic() + self.window_s
+                    while True:
+                        total = self._take_compatible(group)
+                        if (len(group) >= max(1, self._running)
+                                or total >= _MAX_BATCH_TILES):
+                            break
+                        # Futile-wait cut: if every other running
+                        # request already has an incompatible job
+                        # queued (each blocks on its own dispatch, one
+                        # job per request), nothing mergeable can
+                        # arrive — launch now instead of burning the
+                        # window on their critical path.
+                        if self._djobs and len(self._djobs) >= \
+                                self._running - len(group):
+                            break
+                        remaining = limit - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._dq_cv.wait(remaining)
+                elif group[0].mode == "rows":
+                    # No window: merge only what is already queued.
+                    self._take_compatible(group)
+            try:
+                self._launch(group)
+            except Exception:
+                # _launch delivers per-job errors; anything escaping is
+                # a scheduler bug — log it and keep the loop alive so
+                # one bad group cannot wedge every later request.
+                LOG.exception("device loop error on a %d-job group",
+                              len(group))
+                for j in group:
+                    if not j.event.is_set():
+                        j.error = RuntimeError("device launch failed")
+                        j.event.set()
+
+    def _launch(self, group: list) -> None:
+        from ..codec import frontend
+
+        try:
+            if len(group) == 1:
+                group[0].result = frontend.dispatch_frontend(
+                    group[0].plan, group[0].tiles, mode=group[0].mode)
+            else:
+                tiles = np.concatenate([j.tiles for j in group])
+                merged = frontend.dispatch_frontend(
+                    group[0].plan, tiles, mode="rows")
+                off = 0
+                for j in group:
+                    j.result = _SlicedPending(merged, off, j.n_tiles)
+                    off += j.n_tiles
+        # The whole group shares the failed launch; the error is
+        # delivered to every waiting request and re-raised there, so no
+        # waiter hangs and nothing is swallowed.
+        except Exception as exc:    # graftlint: disable=swallowed-exception
+            for j in group:
+                j.error = exc
+        finally:
+            if self._sink is not None:
+                self._sink.count("encode.device_launches")
+                self._sink.count("encode.batched_tiles",
+                                 sum(j.n_tiles for j in group))
+                self._sink.observe("encode.batch_occupancy", len(group))
+            for j in group:
+                j.event.set()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self) -> None:
+        """Stop the device thread and the host pool (tests / embedders;
+        the process-wide instance lives for the process)."""
+        with self._dq_cv:
+            self._stop = True
+            self._dq_cv.notify_all()
+        if self._device_thread is not None:
+            self._device_thread.join(timeout=5)
+        self._pool.shutdown(wait=True)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"running": self._running,
+                    "waiting": len(self._waiting),
+                    "admitted": self._admitted,
+                    "queue_depth": self.queue_depth,
+                    "max_concurrent": self.max_concurrent,
+                    "pool_size": self.pool_size}
+
+
+_GLOBAL: EncodeScheduler | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_scheduler() -> EncodeScheduler:
+    """The process-wide scheduler (lazily built): every converter and
+    worker shares one instance, which is the whole point — cross-request
+    batching only exists if requests meet in the same queues."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = EncodeScheduler()
+        return _GLOBAL

@@ -26,9 +26,11 @@ import urllib.parse
 from .. import config as cfg
 from .. import constants as c
 from .. import features
+from .. import op
 from ..converters import Conversion, ConverterError
 from ..models import WorkflowState
 from .bus import MessageBus, Reply
+from .scheduler import DeadlineExceeded, QueueFull
 from .s3 import S3_UPLOADER
 from .slack import (CSV_DATA, SLACK, SLACK_CHANNEL_ID, SLACK_MESSAGE_TEXT)
 from .store import JobStore, LockTimeout
@@ -75,6 +77,18 @@ class ImageWorker:
         try:
             derivative = await asyncio.to_thread(
                 self.converter.convert, image_id, file_path, conversion)
+        except QueueFull as exc:
+            # Admission backpressure: the encode scheduler's bounded
+            # queue is at depth. 503 + Retry-After, not a 500 — the
+            # client should back off and retry, nothing is broken.
+            if callback_url:
+                await self._patch_callback(callback_url, False)
+            return Reply(op.FAILURE, {c.RETRY_AFTER: exc.retry_after},
+                         503, str(exc))
+        except DeadlineExceeded as exc:
+            if callback_url:
+                await self._patch_callback(callback_url, False)
+            return Reply(op.FAILURE, {c.RETRY_AFTER: 1.0}, 503, str(exc))
         except ConverterError as exc:
             if callback_url:
                 await self._patch_callback(callback_url, False)

@@ -54,9 +54,11 @@ def _html(template: str, **kw) -> str:
     return text
 
 
-def _error_page(status: int, message: str) -> web.Response:
+def _error_page(status: int, message: str,
+                headers: dict | None = None) -> web.Response:
     # reference: FailureHandler.java:57-95 renders error.html
     return web.Response(status=status, content_type="text/html",
+                        headers=headers,
                         text=_html("error.html", status=status,
                                    message=message))
 
@@ -74,9 +76,16 @@ class Api:
         self.metrics = metrics_mod.GLOBAL
         from ..codec import decode as codec_decode
         from ..codec import encoder as codec_encoder
+        from ..engine.scheduler import get_scheduler
         codec_encoder.set_metrics_sink(self.metrics)
         codec_decode.set_metrics_sink(self.metrics)
-        self.reader = TpuReader()
+        # The cross-request encode scheduler reports queue-wait,
+        # per-launch batch occupancy and admission rejects into the
+        # same registry, so /metrics shows the serving picture whole.
+        get_scheduler().set_metrics_sink(self.metrics)
+        self.reader = TpuReader(
+            cache_mb=engine.config.get_int(cfg.DECODE_CACHE_MB, -1),
+            metrics=self.metrics)
         self._background: set[asyncio.Task] = set()
         # Image-mount path prefix (reference: MainVerticle.java:92-102
         # installs it on the JobFactory at boot).
@@ -127,6 +136,16 @@ class Api:
             reply = await self.engine.bus.request_with_retry(
                 IMAGE_WORKER, message)
         if not reply.is_success:
+            if reply.code == 503:
+                # Encode-scheduler backpressure (bounded admission
+                # queue full, or the request's deadline expired): tell
+                # the client when to come back instead of pretending
+                # the service broke.
+                retry_after = reply.body.get(c.RETRY_AFTER, 1)
+                return _error_page(
+                    503, reply.message or "encode queue full",
+                    headers={"Retry-After":
+                             str(max(1, int(round(float(retry_after)))))})
             return _error_page(500, reply.message or "conversion failed")
         # 201 + JSON echo (reference: LoadImageHandler.java:73-75)
         return web.json_response(

@@ -61,16 +61,39 @@ class OverlapStats:
 
 
 @dataclass
+class ValueStats:
+    """Distribution of an observed value (no timing attached): batch
+    occupancy, queue lengths, ... — anything where mean/min/max of the
+    samples is the product metric."""
+    count: int = 0
+    total: float = 0.0
+    vmin: float = 0.0
+    vmax: float = 0.0
+
+    def observe(self, value: float) -> None:
+        if self.count == 0:
+            self.vmin = self.vmax = value
+        else:
+            self.vmin = min(self.vmin, value)
+            self.vmax = max(self.vmax, value)
+        self.count += 1
+        self.total += value
+
+
+@dataclass
 class Metrics:
     stages: dict = field(default_factory=lambda: defaultdict(StageStats))
     overlaps: dict = field(
         default_factory=lambda: defaultdict(OverlapStats))
     counters: dict = field(default_factory=lambda: defaultdict(int))
+    values: dict = field(default_factory=lambda: defaultdict(ValueStats))
     started_at: float = field(default_factory=time.time)
-    # Encodes run on real threads (BatchConverterWorker dispatches
-    # converts via asyncio.to_thread, instances=2), and += on the stat
-    # fields is a read-modify-write — serialize updates or rare-event
-    # counters silently lose increments.
+    # Encodes run on real threads (the scheduler's shared Tier-1 pool,
+    # BatchConverterWorker's asyncio.to_thread converts, instances=2),
+    # and += on the stat fields is a read-modify-write — serialize every
+    # update or rare-event counters silently lose increments. The
+    # single _lock covers stages, overlaps, counters and values; the
+    # hammer test (tests/test_metrics.py) races all four.
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False)
 
@@ -96,9 +119,15 @@ class Metrics:
 
     def count(self, name: str, n: int = 1) -> None:
         """Bump an event counter (PCRD floor re-runs, Tier-2 rebuild
-        iterations, mesh routings, ...)."""
+        iterations, mesh routings, admission rejects, ...)."""
         with self._lock:
             self.counters[name] += n
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample of a value distribution (e.g. the encode
+        scheduler's per-launch batch occupancy)."""
+        with self._lock:
+            self.values[name].observe(float(value))
 
     def report(self) -> dict:
         with self._lock:
@@ -134,6 +163,16 @@ class Metrics:
                     "wall_s": round(ov.wall_s, 3),
                     "saved_s": round(ov.saved_s, 3),
                     "overlap_ratio": round(ov.overlap_ratio, 4),
+                }
+        if self.values:
+            out["values"] = {}
+            for name, vs in sorted(self.values.items()):
+                out["values"][name] = {
+                    "count": vs.count,
+                    "mean": round(vs.total / vs.count, 4) if vs.count
+                    else 0,
+                    "min": round(vs.vmin, 4),
+                    "max": round(vs.vmax, 4),
                 }
         if self.counters:
             out["counters"] = dict(sorted(self.counters.items()))

@@ -122,6 +122,79 @@ def test_seeded_swallowed_exception(tmp_path):
     assert _rules(findings) == ["swallowed-exception"]
 
 
+# --- blocking-call-in-async -------------------------------------------
+
+def test_seeded_blocking_sleep_in_async(tmp_path):
+    root = _make_pkg(tmp_path, {"server/bad.py": """\
+        import time
+
+
+        async def handler(request):
+            time.sleep(1)
+            return request
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["blocking-call-in-async"]
+    assert findings[0].line == 5
+
+
+def test_seeded_blocking_convert_in_async(tmp_path):
+    root = _make_pkg(tmp_path, {"engine/bad.py": """\
+        async def handle(self, message):
+            return self.converter.convert("id", "/p.tif")
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["blocking-call-in-async"]
+
+
+def test_seeded_blocking_reader_read_in_async(tmp_path):
+    """`self.reader.read(...)` is receiver-matched (a bare `read` leaf
+    would false-positive on awaited multipart/file reads)."""
+    root = _make_pkg(tmp_path, {"server/bad.py": """\
+        async def get_image(self, request):
+            img = self.reader.read("/p.jpx", 0, None)
+            data = await request.content.read()
+            return img, data
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["blocking-call-in-async"]
+    assert findings[0].line == 2
+
+
+def test_to_thread_bridged_call_is_clean(tmp_path):
+    """The sanctioned pattern passes the blocking callable as a value
+    to asyncio.to_thread — no call node, no finding. asyncio.sleep is
+    not time.sleep. A nested sync def runs on the executor, not the
+    loop."""
+    root = _make_pkg(tmp_path, {"engine/good.py": """\
+        import asyncio
+
+
+        async def handle(self, message):
+            out = await asyncio.to_thread(
+                self.converter.convert, "id", "/p.tif")
+            await asyncio.sleep(0.1)
+
+            def local_retry():
+                return self.converter.convert("id", "/p.tif")
+
+            return out, await asyncio.to_thread(local_retry)
+        """})
+    assert lint.run_lint(root) == []
+
+
+def test_blocking_async_inline_suppression(tmp_path):
+    root = _make_pkg(tmp_path, {"server/meh.py": """\
+        import time
+
+
+        async def handler(request):
+            time.sleep(0)  # graftlint: disable=blocking-call-in-async
+            return request
+        """})
+    assert lint.run_lint(root) == []
+
+
 # --- the other device-region rules ------------------------------------
 
 def test_tracer_branch_and_float64(tmp_path):

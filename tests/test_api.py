@@ -163,6 +163,41 @@ async def test_failed_convert_500(tmp_path, env_client):
     assert resp.status == 500
 
 
+class BusyConverter:
+    """Converter whose encode queue is at depth: every convert raises
+    the scheduler's admission backpressure error."""
+
+    def convert(self, image_id, source_path, conversion=None):
+        from bucketeer_tpu.engine.scheduler import QueueFull
+        raise QueueFull(4, 7.0)
+
+
+async def test_encode_queue_full_503_with_retry_after(tmp_path,
+                                                      env_client):
+    src = tmp_path / "busy.tif"
+    src.write_bytes(b"II*\x00")
+    client, _ = await env_client(converter=BusyConverter())
+    resp = await client.get(f"/images/busy/{src}")
+    assert resp.status == 503
+    assert resp.headers["Retry-After"] == "7"
+
+
+async def test_scheduler_metrics_wired_into_registry(env_client):
+    """Api boot installs the shared metrics registry into the
+    process-wide scheduler, so queue-wait / occupancy / admission
+    counters land where /metrics serves them."""
+    from bucketeer_tpu.engine.scheduler import get_scheduler
+    from bucketeer_tpu.server import metrics as metrics_mod
+    client, _ = await env_client()
+    sched = get_scheduler()
+    assert sched._sink is metrics_mod.GLOBAL
+    sched._sink.count("encode.admission_rejects")
+    resp = await client.get("/metrics")
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["counters"]["encode.admission_rejects"] >= 1
+
+
 # ---------- batch flow ----------
 
 async def test_full_fake_lambda_e2e(tmp_path, env_client):

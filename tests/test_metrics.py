@@ -1,8 +1,12 @@
 """server/metrics.py: StageStats.record, the Metrics.time context
-manager, and the MPixels/s report."""
+manager, the MPixels/s report, observed-value distributions, and the
+concurrency hammer (every mutator is a read-modify-write; the shared
+lock must make racing updates lossless)."""
+import threading
+
 import pytest
 
-from bucketeer_tpu.server.metrics import Metrics, StageStats
+from bucketeer_tpu.server.metrics import Metrics, StageStats, ValueStats
 
 
 def test_stage_stats_record_accumulates():
@@ -73,3 +77,55 @@ def test_zero_duration_throughput_guard():
     entry = m.report()["stages"]["instant"]
     assert entry["mpixels"] == pytest.approx(1.0)
     assert "mpixels_per_s" not in entry       # no divide-by-zero
+
+
+def test_observe_value_distribution():
+    m = Metrics()
+    for v in (4, 1, 3):
+        m.observe("encode.batch_occupancy", v)
+    entry = m.report()["values"]["encode.batch_occupancy"]
+    assert entry == {"count": 3, "mean": pytest.approx(8 / 3, abs=1e-3),
+                     "min": 1.0, "max": 4.0}
+
+
+def test_value_stats_single_sample_min_max():
+    vs = ValueStats()
+    vs.observe(2.5)
+    assert (vs.vmin, vs.vmax, vs.count) == (2.5, 2.5, 1)
+
+
+def test_concurrent_hammer_never_loses_updates():
+    """Counters, stages, overlaps and values are bumped from the
+    scheduler's Tier-1 pool threads, the engine's to_thread converts
+    and the aiohttp handlers all at once; racing += must never lose an
+    increment."""
+    m = Metrics()
+    n_threads, n_iters = 8, 2500
+    start = threading.Barrier(n_threads)
+
+    def worker(tid):
+        start.wait()
+        for k in range(n_iters):
+            m.count("hammer.counter")
+            m.record("hammer.stage", 0.001, pixels=10, items=2)
+            m.observe("hammer.value", (tid + k) % 5)
+            m.record_overlap("hammer.overlap", 0.001, 0.002, 0.002)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * n_iters
+    rep = m.report()
+    assert rep["counters"]["hammer.counter"] == total
+    st = m.stages["hammer.stage"]
+    assert st.count == total
+    assert st.pixels == 10 * total
+    assert st.items == 2 * total
+    assert st.total_s == pytest.approx(0.001 * total, rel=1e-6)
+    assert m.values["hammer.value"].count == total
+    ov = m.overlaps["hammer.overlap"]
+    assert ov.count == total
+    assert ov.device_s == pytest.approx(0.001 * total, rel=1e-6)

@@ -1,0 +1,110 @@
+"""converters/reader.py decode LRU: hit/miss counters, byte-budget
+eviction, file-identity invalidation, and read-only cache entries."""
+import os
+
+import numpy as np
+import pytest
+
+from bucketeer_tpu.codec import encoder
+from bucketeer_tpu.codec.encoder import EncodeParams
+from bucketeer_tpu.converters.reader import _DecodeCache, TpuReader
+from bucketeer_tpu.server.metrics import Metrics
+
+
+def _write_jp2(tmp_path, name, seed=3, size=64):
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 255, (size, size), dtype=np.uint8)
+    data = encoder.encode_jp2(img, 8, EncodeParams(lossless=True,
+                                                   levels=3))
+    path = tmp_path / name
+    path.write_bytes(data)
+    return str(path), img
+
+
+def test_cache_hit_serves_identical_pixels(tmp_path):
+    path, img = _write_jp2(tmp_path, "a.jp2")
+    sink = Metrics()
+    reader = TpuReader(cache_mb=4, metrics=sink)
+    first = reader.read(path)
+    second = reader.read(path)
+    assert np.array_equal(first, img) and np.array_equal(second, img)
+    counters = sink.report()["counters"]
+    assert counters["decode.cache_misses"] == 1
+    assert counters["decode.cache_hits"] == 1
+
+
+def test_cache_keyed_by_reduce_and_layers(tmp_path):
+    path, _ = _write_jp2(tmp_path, "b.jp2")
+    sink = Metrics()
+    reader = TpuReader(cache_mb=4, metrics=sink)
+    full = reader.read(path)
+    thumb = reader.read(path, reduce=1)
+    assert thumb.shape[0] < full.shape[0]
+    assert np.array_equal(reader.read(path, reduce=1), thumb)
+    counters = sink.report()["counters"]
+    assert counters["decode.cache_misses"] == 2     # distinct keys
+    assert counters["decode.cache_hits"] == 1
+
+
+def test_rewritten_derivative_is_not_served_stale(tmp_path):
+    path, img_a = _write_jp2(tmp_path, "c.jp2", seed=3)
+    reader = TpuReader(cache_mb=4)
+    assert np.array_equal(reader.read(path), img_a)
+    path_b, img_b = _write_jp2(tmp_path, "other.jp2", seed=4)
+    os.replace(path_b, path)          # re-converted derivative
+    # Force a visible identity change even on coarse-mtime filesystems.
+    os.utime(path, ns=(1, 1))
+    assert np.array_equal(reader.read(path), img_b)
+
+
+def test_cached_arrays_are_read_only(tmp_path):
+    path, _ = _write_jp2(tmp_path, "d.jp2")
+    reader = TpuReader(cache_mb=4)
+    reader.read(path)
+    cached = reader.read(path)
+    with pytest.raises(ValueError):
+        cached[0, 0] = 0
+
+
+def test_cache_disabled_with_zero_budget(tmp_path):
+    path, _ = _write_jp2(tmp_path, "e.jp2")
+    sink = Metrics()
+    reader = TpuReader(cache_mb=0, metrics=sink)
+    reader.read(path)
+    reader.read(path)
+    assert reader.cache is None
+    assert "decode.cache_hits" not in sink.report().get("counters", {})
+
+
+def test_lru_eviction_by_byte_budget():
+    cache = _DecodeCache(max_bytes=100)
+    a = np.zeros(40, np.uint8)
+    b = np.zeros(40, np.uint8)
+    c = np.zeros(40, np.uint8)
+    cache.put("a", a)
+    cache.put("b", b)
+    assert cache.get("a") is not None     # refresh a: b becomes LRU
+    cache.put("c", c)
+    assert cache.evictions == 1
+    assert cache.get("b") is None
+    assert cache.get("a") is not None and cache.get("c") is not None
+    assert cache.nbytes <= 100
+
+
+def test_oversized_entry_is_not_cached():
+    cache = _DecodeCache(max_bytes=10)
+    cache.put("big", np.zeros(100, np.uint8))
+    assert len(cache) == 0 and cache.evictions == 0
+
+
+def test_eviction_counter_reaches_metrics(tmp_path):
+    path_a, _ = _write_jp2(tmp_path, "f.jp2", seed=5)
+    path_b, _ = _write_jp2(tmp_path, "g.jp2", seed=6)
+    sink = Metrics()
+    reader = TpuReader(cache_mb=1, metrics=sink)
+    # Shrink the budget below one decoded image so the second read
+    # evicts the first.
+    reader.cache.max_bytes = 5000
+    reader.read(path_a)
+    reader.read(path_b)
+    assert sink.report()["counters"]["decode.cache_evictions"] >= 1
