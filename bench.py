@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import os
+import platform as platform_mod
 import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -106,6 +107,19 @@ def _clear_backends() -> None:
                 continue
 
 
+def _probe_dispatch() -> None:
+    """Force a real compiled-program dispatch. ``jax.devices()``
+    succeeding is not enough: BENCH_r02/r05 died with ``Unable to
+    initialize backend 'axon': UNAVAILABLE`` at the *first dispatch*
+    after the init probe had passed, so the init retry ladder has to
+    exercise the same code path a config's first jit will."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.jit(lambda x: x * 2 + 1)(
+        jnp.arange(16, dtype=jnp.int32)).block_until_ready()
+
+
 def init_backend() -> dict:
     """Bring up a JAX backend, retrying transient TPU setup failures
     (exponential backoff), then falling back to CPU. Returns platform
@@ -118,9 +132,11 @@ def init_backend() -> dict:
     for attempt in range(retries + 1):
         try:
             devices = jax.devices()
+            _probe_dispatch()
             return {"platform": devices[0].platform,
                     "n_devices": len(devices),
                     "attempts": attempt + 1, "fallback": False,
+                    "dispatch_probe": True,
                     "errors": errors}
         # RuntimeError is the documented 'Unable to initialize backend'
         # path; a failed init can also leave xla_bridge half-built so
@@ -136,8 +152,19 @@ def init_backend() -> dict:
     _clear_backends()
     jax.config.update("jax_platforms", "cpu")
     devices = jax.devices()
+    # The fallback backend gets the same real-dispatch probe as the
+    # primary: a broken CPU fallback must surface here as a labelled
+    # init failure, not as a mid-config crash behind an asserted probe.
+    probe_ok = True
+    try:
+        _probe_dispatch()
+    except Exception as exc:
+        probe_ok = False
+        errors.append(f"cpu fallback probe: {type(exc).__name__}: "
+                      + str(exc).split("\n")[0][:200])
     return {"platform": devices[0].platform, "n_devices": len(devices),
-            "attempts": retries + 1, "fallback": True, "errors": errors}
+            "attempts": retries + 1, "fallback": True,
+            "dispatch_probe": probe_ok, "errors": errors}
 
 
 # --- synthetic content ---------------------------------------------------
@@ -179,6 +206,23 @@ def _timed(fn, repeats: int) -> tuple:
         result = fn()
         best = min(best, time.perf_counter() - t0)
     return best, result
+
+
+def _stage_profile(sink, prefixes=("encode.", "decode.")) -> dict:
+    """Per-stage split from a Metrics sink, for the bench JSON: the
+    same stage registry /metrics serves (front-end dispatch vs CX/D vs
+    MQ replay vs Tier-2, decode segments), no parallel timer set to
+    drift out of sync."""
+    out = {}
+    for name, st in sink.report()["stages"].items():
+        if not name.startswith(tuple(prefixes)):
+            continue
+        entry = {"total_s": st["total_s"], "count": st["count"]}
+        for k in ("mpixels_per_s", "items_per_s", "items"):
+            if k in st:
+                entry[k] = st[k]
+        out[name] = entry
+    return out
 
 
 # --- configs -------------------------------------------------------------
@@ -311,6 +355,8 @@ def config1_single_4k(repeats: int) -> dict:
     from bucketeer_tpu.codec import encoder
     from bucketeer_tpu.codec.encoder import EncodeParams
 
+    from bucketeer_tpu.server.metrics import Metrics
+
     size = _env_int("BENCH_SIZE", 4096, smoke=512)
     img = synthetic_photo(size)
     params = EncodeParams.kakadu_recipe(lossless=False, rate=3.0)
@@ -318,8 +364,15 @@ def config1_single_4k(repeats: int) -> dict:
     # different chunk/batch-bucket program variants and leave XLA
     # compiles inside the first timed repeat.
     encoder.encode_jp2(img, 8, params)
-    best, data = _timed(lambda: encoder.encode_jp2(img, 8, params),
-                        repeats)
+    # Per-stage split of the timed repeats via the /metrics stage
+    # registry (ROADMAP item 5: where does the wall clock actually go).
+    sink = Metrics()
+    encoder.set_metrics_sink(sink)
+    try:
+        best, data = _timed(lambda: encoder.encode_jp2(img, 8, params),
+                            repeats)
+    finally:
+        encoder.set_metrics_sink(None)
     mpix = size * size / 1e6
     result = {"value": round(mpix / best, 3), "unit": "MPix/s",
               "seconds": round(best, 3),
@@ -327,6 +380,7 @@ def config1_single_4k(repeats: int) -> dict:
               "recipe": "kakadu rate=3 tiles=512 levels=6",
               "output_bytes": len(data),
               "bpp": round(8.0 * len(data) / (size * size), 3),
+              "stage_profile": _stage_profile(sink),
               "repeats": repeats}
     if _want_tier1_split():
         # On CPU, bound the jnp-scan 'device' cost: the host-segment
@@ -641,6 +695,154 @@ def config7_concurrent_serving(repeats: int) -> dict:
         sched.close()
 
 
+def config8_tile_storm(repeats: int) -> dict:
+    """Closed-loop tile-request storm against the random-access read
+    path (the GET /images?region= engine): N clients pull tile regions
+    of a stored derivative through the shared scheduler at read
+    priority. Two phases — cache-cold (every tile distinct: index
+    build + indexed Tier-2 + windowed Tier-1/inverse) and cache-warm
+    (same tiles again: decoded-tile LRU hits) — reporting aggregate
+    tiles/s and p50/p95 latency per phase against the whole-image-decode
+    baseline (what serving a tile cost before random access). Env:
+    BENCH_STORM_SIZE, BENCH_STORM_TILE, BENCH_STORM_CLIENTS."""
+    import dataclasses
+    import queue as queue_mod
+    import tempfile
+    import threading
+
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.decode import decode, set_metrics_sink
+    from bucketeer_tpu.codec.encoder import EncodeParams
+    from bucketeer_tpu.converters.reader import TpuReader
+    from bucketeer_tpu.engine.scheduler import Scheduler
+    from bucketeer_tpu.server.metrics import Metrics
+
+    size = _env_int("BENCH_STORM_SIZE", 1024, smoke=256)
+    tile = _env_int("BENCH_STORM_TILE", max(64, size // 8), smoke=64)
+    clients = _env_int("BENCH_STORM_CLIENTS", 4, smoke=4)
+    img = synthetic_photo(size)
+    # The reference recipe (RPCL + PLT + R tile-parts): the index build
+    # takes the PLT arithmetic path, no header walk.
+    params = dataclasses.replace(
+        EncodeParams.kakadu_recipe(lossless=False, rate=3.0),
+        tile_size=min(512, size))
+    data = encoder.encode_jp2(img, 8, params)
+
+    # Whole-image-decode baseline: what one tile request costs when the
+    # server can only decode everything and crop.
+    decode(data)                                   # warm the compiles
+    base_s, full = _timed(lambda: decode(data), max(1, repeats))
+
+    tiles = [(x, y, tile, tile)
+             for y in range(0, size, tile) for x in range(0, size, tile)]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "storm.jp2")
+        with open(path, "wb") as fh:
+            fh.write(data)
+        sink = Metrics()
+        sched = Scheduler(max_concurrent=max(2, clients),
+                          queue_depth=4 * clients)
+        sched.set_metrics_sink(sink)
+        set_metrics_sink(sink)
+        reader = TpuReader(cache_mb=256, metrics=sink, scheduler=sched)
+
+        def run_phase(check_against=None) -> dict:
+            work: queue_mod.Queue = queue_mod.Queue()
+            for t in tiles:
+                work.put(t)
+            lats: list = []
+            errs: list = []
+            lock = threading.Lock()
+
+            def client() -> None:
+                while True:
+                    try:
+                        region = work.get_nowait()
+                    except queue_mod.Empty:
+                        return
+                    t0 = time.perf_counter()
+                    try:
+                        out = reader.read(path, region=region)
+                    except BaseException as exc:
+                        errs.append(exc)
+                        return
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lats.append(dt)
+                    if check_against is not None:
+                        x, y, w, h = region
+                        if not np.array_equal(
+                                out, check_against[y:y + h, x:x + w]):
+                            errs.append(AssertionError(
+                                f"tile {region} not bit-exact"))
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(clients)]
+            w0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - w0
+            if errs:
+                raise errs[0]
+            lats.sort()
+            return {"tiles": len(lats),
+                    "seconds": round(wall, 3),
+                    "tiles_per_s": round(len(lats) / wall, 2),
+                    "p50_ms": round(1e3 * lats[len(lats) // 2], 1),
+                    "p95_ms": round(
+                        1e3 * lats[min(len(lats) - 1,
+                                       int(len(lats) * 0.95))], 1),
+                    "mean_ms": round(1e3 * sum(lats) / len(lats), 2)}
+
+        try:
+            # Warm the region-inverse compiles (one pass), then drop
+            # both cache tiers so the cold phase measures the whole
+            # random-access path (index build included), not XLA
+            # compilation.
+            run_phase()
+            reader.reset_caches(tiles=True, index=True)
+            sink2 = Metrics()
+            sched.set_metrics_sink(sink2)
+            reader.metrics = sink2
+            set_metrics_sink(sink2)
+            cold = run_phase(check_against=full)
+            warm = run_phase()
+            rep = sink2.report()
+            counters = rep.get("counters", {})
+        finally:
+            set_metrics_sink(None)
+            sched.close()
+
+    # Aggregate serving throughput vs a whole-image-decode server on
+    # the same hardware (which, like us, is GIL-bound across clients):
+    # it serves at most 1/full_s tiles/s however many clients connect.
+    speedup = cold["tiles_per_s"] * base_s
+    return {
+        "value": cold["tiles_per_s"], "unit": "tiles/s",
+        "seconds": cold["seconds"],
+        "image": f"{size}x{size}x3 uint8 rate=3",
+        "tile": f"{tile}x{tile}",
+        "tile_area_fraction": round(tile * tile / (size * size), 5),
+        "clients": clients,
+        "cold": cold, "warm": warm,
+        "full_decode_baseline_s": round(base_s, 3),
+        "speedup_vs_full_decode": round(speedup, 2),
+        "region_blocks": counters.get("decode.region_blocks", 0),
+        "cache": {
+            "tile_hits": counters.get("decode.cache_hits", 0),
+            "tile_misses": counters.get("decode.cache_misses", 0),
+            "index_hits": counters.get("decode.index_cache_hits", 0),
+            "index_misses": counters.get("decode.index_cache_misses", 0),
+        },
+        "admission_rejects": counters.get("decode.admission_rejects", 0),
+        "stage_profile": _stage_profile(sink2, ("decode.",)),
+        "repeats": repeats,
+    }
+
+
 CONFIGS = {
     "1_single_4k_rate3": config1_single_4k,
     "2_batch_2k_lossy": config2_batch_2k,
@@ -649,6 +851,7 @@ CONFIGS = {
     "5_mixed_upload_overlap": config5_mixed_overlap,
     "6_decode_roundtrip": config6_decode,
     "7_concurrent_serving": config7_concurrent_serving,
+    "8_tile_storm": config8_tile_storm,
 }
 
 
@@ -708,7 +911,18 @@ def main() -> int:
         # re-exec'd the sweep onto CPU.
         "platform_fallback": bool(backend["fallback"]
                                   or os.environ.get(_REEXEC_ENV)),
+        # A fallback run is NOT a device measurement: consumers (the CI
+        # regression gate, the scoreboard) must treat these numbers as
+        # CPU plumbing checks, never as accelerator throughput.
+        "device_run_valid": not bool(backend["fallback"]
+                                     or os.environ.get(_REEXEC_ENV)),
         "backend": backend,
+        # Coarse machine class for the regression gate: wall-clock
+        # throughput is only comparable between runs of the same class
+        # (hosted-runner vs dev-box variance alone exceeds the gate's
+        # loss threshold).
+        "machine": {"arch": platform_mod.machine(),
+                    "cpu_count": os.cpu_count()},
         "smoke": SMOKE,
         "compile_cache": {
             "enabled": cache["enabled"], "dir": cache["dir"],

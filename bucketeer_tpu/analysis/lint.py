@@ -176,7 +176,8 @@ def run_lint(root: Path, baseline: set | None = None,
     """Lint the package at ``root``; returns surviving findings sorted by
     (path, line). ``native_dir`` defaults to ``root``/native when present
     (set it explicitly to cross-check an out-of-tree fixture)."""
-    from . import abi, rules_async, rules_hygiene, rules_jax
+    from . import abi, rules_async, rules_donation, rules_hygiene, \
+        rules_jax
 
     project = load_project(Path(root))
     findings: list = []
@@ -189,6 +190,7 @@ def run_lint(root: Path, baseline: set | None = None,
     findings += rules_jax.run(project)
     findings += rules_hygiene.run(project)
     findings += rules_async.run(project)
+    findings += rules_donation.run(project)
     if native_dir is None:
         candidate = Path(root) / "native"
         native_dir = candidate if candidate.is_dir() else None

@@ -1,0 +1,72 @@
+"""missing-donation: jitted entry points that never donate their inputs.
+
+The codec's jitted programs consume large freshly-staged host arrays —
+a tile batch, a half-magnitude coefficient batch — that no caller reads
+after the launch. Without ``donate_argnums`` XLA must keep the input
+buffer alive alongside the output, doubling (or worse) the HBM
+high-water mark of every launch; with it the input aliases into the
+output. Donation is free to request and silently ignored only where
+unsupported (the CPU backend warns — the codec gates it through
+``pipeline.donate_argnums_if_supported``), so a jit call in the hot
+modules with *no* donation spec is either an oversight or needs an
+explicit whitelist entry explaining why aliasing would be wrong.
+
+Scope: the device entry points of the encode front-end
+(``codec/frontend.py``) and the decode back half
+(``codec/decode/device.py``) — the two modules whose array operands are
+tile-sized. Whitelisted: ``gather`` (the chunked payload gather re-reads
+the same device ``rows`` buffer across successive dispatches; donating
+it would free a buffer later chunks still read).
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import ERROR, Finding
+from .rules_jax import _attr_root, _unwrap_jit_target
+
+MISSING_DONATION = "missing-donation"
+
+# Module suffixes whose jit roots stage tile-sized arrays per launch.
+SCOPES = ("codec/frontend.py", "codec/decode/device.py")
+
+# Jitted functions where donation is *unsafe*, with the reason on
+# record: the buffer outlives the launch.
+WHITELIST = {
+    "gather",        # frontend._compiled_gather: `rows` is shared by
+                     # every chunk of one payload fetch
+}
+
+DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+
+
+def run(project) -> list:
+    findings: list = []
+    for mod in project.modules:
+        if not mod.relpath.endswith(SCOPES):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            root, chain = _attr_root(node.func)
+            leaf = chain[-1] if chain else root
+            is_jit = ((root in mod.jax_aliases
+                       and leaf in ("jit", "pmap"))
+                      or root in mod.jit_names)
+            if not is_jit:
+                continue
+            name, _ = _unwrap_jit_target(mod, node.args[0])
+            if name in WHITELIST:
+                continue
+            if any(kw.arg in DONATE_KWARGS for kw in node.keywords):
+                continue
+            findings.append(Finding(
+                MISSING_DONATION, mod.relpath, node.lineno,
+                f"jit of {name or '<anonymous>'} donates none of its "
+                "array arguments: the staged input buffer stays live "
+                "beside the output for the whole launch. Pass "
+                "donate_argnums (pipeline.donate_argnums_if_supported "
+                "gates CPU), or whitelist the function in "
+                "rules_donation with the reason aliasing is unsafe",
+                ERROR, mod.source_line(node.lineno)))
+    return findings

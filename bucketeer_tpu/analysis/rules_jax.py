@@ -67,7 +67,7 @@ SYNC_METHODS = {"item", "tolist", "block_until_ready",
 D2H_SANCTIONED = {"fetch_payload", "gather_rows", "run_frontend",
                   "run_tiles", "run_tiles_sharded", "resolve_stats",
                   "_host_stats", "run_cxd", "sharded_transform_tile",
-                  "run_inverse"}
+                  "run_inverse", "run_region_inverse"}
 D2H_SCOPES = ("codec", "parallel")
 
 

@@ -30,8 +30,9 @@ import numpy as np
 
 from ...analysis import retrace
 from ...analysis.contracts import contract
-from ..dwt import dwt2d_inverse
-from ..pipeline import _band_geometry, _bucket
+from ..dwt import _along_rows, _inv53_last, dwt2d_inverse
+from ..pipeline import (_band_geometry, _bucket,
+                        donate_argnums_if_supported)
 from ..transforms import ict_inverse, level_shift_inverse, rct_inverse
 
 
@@ -106,7 +107,203 @@ def _compiled_inverse(plan: InversePlan):
     half_map = (None if plan.reversible
                 else jnp.asarray(_half_step_map(plan)))
     return jax.jit(retrace.instrument(
-        "inverse", partial(_inverse_body, plan, half_map)))
+        "inverse", partial(_inverse_body, plan, half_map)),
+        donate_argnums=donate_argnums_if_supported(0))
+
+
+# --- windowed (region) inverse -------------------------------------------
+#
+# A region read must not pay for the whole tile: the synthesis needs
+# only a halo-expanded window of each subband. The halo rule that keeps
+# the window self-sufficient: boundary effects penetrate at most one
+# sample per lifting step inward from a window edge, so a halo of 2
+# coefficients per side per level suffices for the 2-step 5/3 and 4 for
+# the 4-step 9/7 — except at true tile boundaries, where the window
+# clamps and the reflect extension is exactly the full decode's. Window
+# starts are rounded down to even so the lo/hi interleave parity matches
+# the full transform. The halo governs *which code-blocks Tier-1 must
+# decode* for both wavelets.
+#
+# How the device half runs the window differs by wavelet:
+#
+# - reversible (5/3): a dedicated windowed program — integer lifting is
+#   immune to compiler rewrites, so the windowed result is bit-identical
+#   to the full decode's crop by arithmetic, at any shape.
+# - irreversible (9/7): float codegen is shape-dependent (XLA fuses /
+#   contracts differently per array width — measured 1-ulp differences
+#   that flip a x.5 rounding), so a differently-shaped windowed program
+#   cannot promise the bit-exact-crop contract. Instead the windowed
+#   coefficients scatter into a zeroed full-tile Mallat plane and run
+#   the *same compiled program* as the full decode (shared cache entry,
+#   zero extra compiles); samples inside the window only depend on the
+#   halo-covered coefficients, so the crop is bit-exact by construction.
+#   Device FLOPs are the cheap part of a read — Tier-2 and host Tier-1,
+#   where the windowing earns its 10-100x, stay windowed either way.
+
+
+def halo(reversible: bool) -> int:
+    """Per-side, per-level coefficient halo for a bit-exact windowed
+    inverse DWT (lifting-step count of the synthesis filter)."""
+    return 2 if reversible else 4
+
+
+@dataclass(frozen=True)
+class RegionPlan:
+    """Static decode plan for one (tile shape, window) pair.
+
+    ``slots`` carries ``(name, level, by0, by1, bx0, bx1, delta)`` —
+    the *window rectangle in band coordinates* (tile-local) of every
+    subband the synthesis needs, level 1 = finest, LL carrying
+    ``level == levels``. ``steps`` is one entry per synthesis level,
+    coarsest first: the crop applied after that level's interleave,
+    relative to the level's interleaved window."""
+    tile_h: int              # reduced tile height (context for caching)
+    tile_w: int
+    n_comps: int
+    levels: int              # levels remaining after ``reduce``
+    reversible: bool
+    bitdepth: int
+    used_mct: bool
+    out_h: int               # final window extent (== y1 - y0)
+    out_w: int
+    win: tuple               # (y0, y1, x0, x1) tile-local sample window
+    slots: tuple             # ((name, lvl, by0, by1, bx0, bx1, delta), ...)
+    steps: tuple             # ((ry0, ry1, rx0, rx1), ...) coarse -> fine
+
+
+def _window_chain(a: int, b: int, n: int, levels: int, r: int) -> tuple:
+    """Per-dimension window recursion: for each decomposition level
+    (finest first) the halo-expanded, even-aligned interleaved window
+    plus its lo/hi halves; the needed span of the next-coarser LL is the
+    lo half. Returns ([(u0, u1, lo, hi, s_prev)], final LL span)."""
+    out = []
+    s0, s1 = a, b
+    for _ in range(levels):
+        u0 = max(0, s0 - r) & ~1
+        u1 = min(n, s1 + r)
+        lo = (u0 >> 1, (u1 + 1) >> 1)
+        hi = (u0 >> 1, u1 >> 1)
+        out.append((u0, u1, lo, hi, (s0, s1)))
+        s0, s1 = lo
+        n = (n + 1) >> 1
+    return out, (s0, s1)
+
+
+def make_region_plan(rh: int, rw: int, n_comps: int, levels: int,
+                     reversible: bool, bitdepth: int, used_mct: bool,
+                     delta_of, y0: int, y1: int, x0: int,
+                     x1: int) -> RegionPlan:
+    """Plan a windowed inverse reconstructing tile-local samples
+    ``[y0, y1) x [x0, x1)`` of an (rh, rw) reduced tile. ``delta_of``
+    as in :func:`make_inverse_plan`."""
+    r = halo(reversible)
+    rows, ll_r = _window_chain(y0, y1, rh, levels, r)
+    cols, ll_c = _window_chain(x0, x1, rw, levels, r)
+    slots = []
+    for lvl in range(1, levels + 1):
+        _, _, lo_r, hi_r, _ = rows[lvl - 1]
+        _, _, lo_c, hi_c, _ = cols[lvl - 1]
+        slots.append(("HL", lvl, lo_r[0], lo_r[1], hi_c[0], hi_c[1],
+                      float(delta_of(lvl, "HL"))))
+        slots.append(("LH", lvl, hi_r[0], hi_r[1], lo_c[0], lo_c[1],
+                      float(delta_of(lvl, "LH"))))
+        slots.append(("HH", lvl, hi_r[0], hi_r[1], hi_c[0], hi_c[1],
+                      float(delta_of(lvl, "HH"))))
+    slots.append(("LL", levels, ll_r[0], ll_r[1], ll_c[0], ll_c[1],
+                  float(delta_of(levels, "LL"))))
+    steps = []
+    for lvl in range(levels, 0, -1):
+        u0r, _, _, _, (sa_r, sb_r) = rows[lvl - 1]
+        u0c, _, _, _, (sa_c, sb_c) = cols[lvl - 1]
+        steps.append((sa_r - u0r, sb_r - u0r, sa_c - u0c, sb_c - u0c))
+    return RegionPlan(rh, rw, n_comps, levels, reversible, bitdepth,
+                      used_mct, y1 - y0, x1 - x0, (y0, y1, x0, x1),
+                      tuple(slots), tuple(steps))
+
+
+def _region_body(levels: int, steps: tuple, used_mct: bool,
+                 bitdepth: int, hvs):
+    """Windowed reversible synthesis: per-slot (C, bh, bw) int32
+    half-magnitudes -> (h, w, C) int32 samples for the planned window.
+    Integer lifting end to end, so the result is rewrite-immune and
+    bit-identical to the full decode's crop at any window shape. Slot
+    order is the RegionPlan convention: (HL, LH, HH) per level, LL
+    last."""
+    vals = {}
+    names = [(name, lvl) for lvl in range(1, levels + 1)
+             for name in ("HL", "LH", "HH")] + [("LL", levels)]
+    for (name, lvl), hv in zip(names, hvs):
+        mag = jnp.abs(hv) >> 1
+        vals[(name, lvl)] = jnp.where(hv < 0, -mag, mag)
+    ll = vals[("LL", levels)]
+    for lvl in range(levels, 0, -1):
+        v_lo = _inv53_last(ll, vals[("HL", lvl)])
+        v_hi = _inv53_last(vals[("LH", lvl)], vals[("HH", lvl)])
+        ll = _along_rows(_inv53_last, v_lo, v_hi)
+        ry0, ry1, rx0, rx1 = steps[levels - lvl]
+        ll = ll[..., ry0:ry1, rx0:rx1]
+    x = jnp.moveaxis(ll, 0, -1)                   # (h, w, C)
+    if used_mct:
+        x = rct_inverse(x)
+    x = level_shift_inverse(x, bitdepth)
+    x = jnp.clip(x, 0, (1 << bitdepth) - 1)
+    return x.astype(jnp.int32)
+
+
+def _compiled_region_inverse(plan: RegionPlan):
+    # Key on what actually enters the trace — levels, relative crop
+    # steps, MCT, bitdepth (plus the slot shapes, which jit buckets
+    # itself) — so same-size same-parity windows at different (x, y)
+    # share one compiled program instead of one per tile position.
+    return _compiled_region_inverse_cached(
+        plan.levels, plan.steps, plan.used_mct, plan.bitdepth)
+
+
+@lru_cache(maxsize=256)
+def _compiled_region_inverse_cached(levels: int, steps: tuple,
+                                    used_mct: bool, bitdepth: int):
+    return jax.jit(retrace.instrument(
+        "region_inverse",
+        partial(_region_body, levels, steps, used_mct, bitdepth)),
+        donate_argnums=donate_argnums_if_supported(0))
+
+
+def _full_plan_from_region(plan: RegionPlan) -> InversePlan:
+    """The full-tile InversePlan a region plan's stream would use — the
+    irreversible region path runs this (cache-shared with full decodes)
+    so its float codegen is the full decode's, bit for bit."""
+    deltas = {(name, lvl): delta
+              for name, lvl, _, _, _, _, delta in plan.slots}
+    return make_inverse_plan(
+        plan.tile_h, plan.tile_w, plan.n_comps, plan.levels,
+        plan.reversible, plan.bitdepth, plan.used_mct,
+        lambda lvl, name: deltas[(name, lvl)])
+
+
+def run_region_inverse(plan: RegionPlan, hv_slots: list) -> np.ndarray:
+    """Device back half of a region read: per-slot (C, bh, bw) int32
+    half-magnitude window arrays (RegionPlan slot order) ->
+    (out_h, out_w, C) int32 samples. Reversible streams run the
+    dedicated windowed program; irreversible streams scatter the window
+    into a zeroed full-tile plane and run the full decode's own program
+    (see the module comment on why that is what keeps the float path
+    bit-exact)."""
+    if plan.reversible:
+        fn = _compiled_region_inverse(plan)
+        out = fn(tuple(jnp.asarray(a) for a in hv_slots))
+        return np.asarray(jax.device_get(out))
+    planes = np.zeros((plan.n_comps, plan.tile_h, plan.tile_w),
+                      dtype=np.int32)
+    origins = {(name, lvl): (y0, x0)
+               for name, lvl, y0, x0, _, _ in _band_geometry(
+                   plan.tile_h, plan.tile_w, plan.levels)}
+    for (name, lvl, by0, by1, bx0, bx1, _), hv in zip(plan.slots,
+                                                      hv_slots):
+        y0, x0 = origins[(name, lvl)]
+        planes[:, y0 + by0:y0 + by1, x0 + bx0:x0 + bx1] = hv
+    samples = run_inverse(_full_plan_from_region(plan), planes[None])[0]
+    wy0, wy1, wx0, wx1 = plan.win
+    return samples[wy0:wy1, wx0:wx1]
 
 
 @contract(shapes={"hvals": ("B", "C", "h", "w")},

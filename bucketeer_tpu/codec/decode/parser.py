@@ -209,6 +209,11 @@ class ParsedStream:
     n_packets_skipped: int = 0   # skipped wholesale by partial decode
     bytes_total: int = 0     # codestream bytes
     bytes_parsed: int = 0    # tile bytes the packet walk actually visited
+    precinct_exps: list | None = None    # signaled (or default) PPx/PPy
+    # Filled by parse(collect_index=True) — the raw material of the
+    # random-access stream index (decode/index.py):
+    packet_index: dict | None = None  # tidx -> [(comp,res,p_idx,layer,off,len)]
+    tile_spans: dict | None = None    # tidx -> [(start, end)] codestream spans
 
 
 def _parse_siz(payload: bytes) -> tuple:
@@ -521,44 +526,19 @@ def probe(data: bytes) -> dict:
             "progression": cod["progression"]}
 
 
-def parse(data: bytes, reduce: int = 0,
-          layers: int | None = None) -> ParsedStream:
-    """Parse a JP2 file or raw codestream into per-block segment lists.
-
-    ``reduce`` drops the finest ``reduce`` resolutions; ``layers`` caps
-    the quality layers whose bodies are kept. Raises DecodeError on any
-    malformed or unsupported input.
-    """
-    if reduce < 0:
-        raise InvalidParam(f"invalid reduce {reduce}")
-    if layers is not None and layers < 1:
-        raise InvalidParam(f"invalid layers {layers}")
-    code = unbox_jp2(data)
-    r = _Reader(code)
-    if r.u16() != cs.SOC:
-        raise DecodeError("missing SOC marker")
-    siz, cod, guard, quants = _parse_main_header(r)
-
-    width, height, n_comps, bitdepth, tile_w, tile_h = siz
-    if reduce > cod["levels"]:
-        raise InvalidParam(
-            f"reduce={reduce} exceeds {cod['levels']} decomposition "
-            "levels")
-    max_layers = cod["n_layers"] if layers is None else layers
-    ps = ParsedStream(width, height, n_comps, bitdepth, tile_w, tile_h,
-                      cod["levels"], cod["n_layers"], cod["progression"],
-                      cod["mct"], cod["reversible"], guard,
-                      cod["xcb"], cod["ycb"], quants, [],
-                      use_sop=cod["use_sop"], use_eph=cod["use_eph"],
-                      bytes_total=len(code))
-
-    # --- tile-parts: collect each tile's packet bytes in stream order ---
-    n_tiles = _ceil_div(width, tile_w) * _ceil_div(height, tile_h)
-    tile_bytes: dict = {}
+def _iter_tile_parts(r: _Reader, code: bytes, n_tiles: int,
+                     on_segment=None):
+    """Walk the codestream's tile-parts from the first SOT (already
+    consumed by the main-header parse) to EOC, validating SOT fields
+    and the header segments up to SOD; yields ``(isot, body_start,
+    part_end)`` per tile-part. ``on_segment(isot, marker, payload)``
+    sees every header segment (the PLT index build); None skips them.
+    The single walker keeps the sequential parse and the stream-index
+    build accepting and rejecting exactly the same streams."""
     marker = cs.SOT
     while True:
         if marker == cs.EOC:
-            break
+            return
         if marker != cs.SOT:
             raise DecodeError(f"expected SOT, got 0x{marker:04x}")
         sot_start = r.pos - 2
@@ -589,11 +569,62 @@ def parse(data: bytes, reduce: int = 0,
             ln = r.u16()
             if ln < 2 or r.pos + ln - 2 > part_end:
                 raise DecodeError("tile-part header segment overruns")
-            r.raw(ln - 2)         # PLT / COM: skip
-        tile_bytes.setdefault(isot, bytearray()).extend(
-            code[r.pos:part_end])
+            payload = r.raw(ln - 2)       # PLT / COM
+            if on_segment is not None:
+                on_segment(isot, m, payload)
+        yield isot, r.pos, part_end
         r.pos = part_end
         marker = r.u16()
+
+
+def parse(data: bytes, reduce: int = 0, layers: int | None = None,
+          collect_index: bool = False) -> ParsedStream:
+    """Parse a JP2 file or raw codestream into per-block segment lists.
+
+    ``reduce`` drops the finest ``reduce`` resolutions; ``layers`` caps
+    the quality layers whose bodies are kept. Raises DecodeError on any
+    malformed or unsupported input.
+
+    ``collect_index=True`` additionally records per-packet (offset,
+    length) pairs and per-tile byte spans on the returned stream
+    (``packet_index`` / ``tile_spans``) — the tag-tree-walk path of
+    :func:`index.build_index`. Requires a full parse (an early-stopped
+    partial walk would index only a prefix).
+    """
+    if reduce < 0:
+        raise InvalidParam(f"invalid reduce {reduce}")
+    if layers is not None and layers < 1:
+        raise InvalidParam(f"invalid layers {layers}")
+    if collect_index and (reduce or layers is not None):
+        raise ValueError("collect_index needs a full parse "
+                         "(reduce=0, layers=None)")
+    code = unbox_jp2(data)
+    r = _Reader(code)
+    if r.u16() != cs.SOC:
+        raise DecodeError("missing SOC marker")
+    siz, cod, guard, quants = _parse_main_header(r)
+
+    width, height, n_comps, bitdepth, tile_w, tile_h = siz
+    if reduce > cod["levels"]:
+        raise InvalidParam(
+            f"reduce={reduce} exceeds {cod['levels']} decomposition "
+            "levels")
+    max_layers = cod["n_layers"] if layers is None else layers
+    ps = ParsedStream(width, height, n_comps, bitdepth, tile_w, tile_h,
+                      cod["levels"], cod["n_layers"], cod["progression"],
+                      cod["mct"], cod["reversible"], guard,
+                      cod["xcb"], cod["ycb"], quants, [],
+                      use_sop=cod["use_sop"], use_eph=cod["use_eph"],
+                      bytes_total=len(code))
+
+    # --- tile-parts: collect each tile's packet bytes in stream order ---
+    n_tiles = _ceil_div(width, tile_w) * _ceil_div(height, tile_h)
+    tile_bytes: dict = {}
+    tile_spans: dict = {}
+    for isot, body_start, part_end in _iter_tile_parts(r, code, n_tiles):
+        tile_bytes.setdefault(isot, bytearray()).extend(
+            code[body_start:part_end])
+        tile_spans.setdefault(isot, []).append((body_start, part_end))
 
     if len(tile_bytes) != n_tiles:
         raise DecodeError(
@@ -603,7 +634,11 @@ def parse(data: bytes, reduce: int = 0,
     # --- packet walk per tile ---
     max_res = ps.levels - reduce
     exps = cod["precinct_exps"] or _default_exps(ps.levels)
+    ps.precinct_exps = exps
     res_major = ps.progression in (cs.PROG_RPCL, cs.PROG_RLCP)
+    if collect_index:
+        ps.packet_index = {}
+        ps.tile_spans = tile_spans
     for tidx in sorted(tile_bytes):
         tile = _build_tile(ps, tidx)
         records = _build_precincts(ps, tile, exps)
@@ -611,6 +646,7 @@ def parse(data: bytes, reduce: int = 0,
         pos, end = 0, len(buf)
         seq = _packet_sequence(ps.progression, records, ps.levels + 1,
                                n_comps, ps.n_layers)
+        entries = [] if collect_index else None
         for rec, layer in seq:
             if res_major and rec.res > max_res:
                 # Everything after this packet in a resolution-major
@@ -623,8 +659,14 @@ def parse(data: bytes, reduce: int = 0,
                 ps.n_packets_skipped += sum(1 for _ in seq) + 1
                 break
             store = rec.res <= max_res and layer < max_layers
+            start = pos
             pos = _parse_packet(ps, buf, pos, end, rec, layer, store)
+            if entries is not None:
+                entries.append((rec.comp, rec.res, rec.p_idx, layer,
+                                start, pos - start))
             ps.n_packets += 1
+        if entries is not None:
+            ps.packet_index[tidx] = entries
         ps.bytes_parsed += pos
         ps.tiles.append(tile)
     return ps

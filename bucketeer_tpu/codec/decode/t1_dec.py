@@ -27,11 +27,40 @@ Code-blocks are independent; ``decode_blocks`` is the batch entry.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import numpy as np
 
 from ..mq import CTX_RL, CTX_UNIFORM, MQDecoder
 from ..t1 import _SC, _ZC_HH, _ZC_LL_LH
 from .errors import DecodeError
+
+_services = threading.local()
+
+
+@contextlib.contextmanager
+def decode_services(check=None):
+    """Install a per-thread hook polled between code-blocks in
+    :func:`decode_blocks` — the decode-side mirror of the encoder's
+    ``pipeline_services`` seam. The scheduler uses it to enforce read
+    deadlines mid-decode instead of only while queued."""
+    prev = getattr(_services, "check", None)
+    _services.check = check
+    try:
+        yield
+    finally:
+        _services.check = prev
+
+
+def poll() -> None:
+    """Run this thread's installed check (deadline enforcement) — a
+    no-op when none is installed. For code on the admitted read path
+    that waits outside :func:`decode_blocks` (e.g. single-flight index
+    waiters) and must still honor the request deadline."""
+    check = getattr(_services, "check", None)
+    if check is not None:
+        check()
 
 
 def _flat_zc(table, swap_hv: bool) -> list:
@@ -248,7 +277,10 @@ def decode_blocks(specs: list) -> tuple:
     here — the pure-Python MQ loop is GIL-bound either way."""
     out = []
     total = 0
+    check = getattr(_services, "check", None)
     for data, nbps, npasses, band, h, w in specs:
+        if check is not None:
+            check()
         hv, n = decode_block(data, nbps, npasses, band, h, w)
         out.append(hv)
         total += n

@@ -38,7 +38,8 @@ import numpy as np
 
 from ..analysis import retrace
 from ..analysis.contracts import contract
-from .pipeline import TilePlan, _bucket, _step_map, _transform_batch
+from .pipeline import (TilePlan, _bucket, _step_map, _transform_batch,
+                       donate_argnums_if_supported)
 from .quant import FRAC_BITS
 
 CBLK = 64
@@ -178,9 +179,12 @@ def _frontend_body(plan: TilePlan, P: int, frac_bits: int, mode: str,
 def _compiled_frontend(plan: TilePlan, P: int, mode: str = "rows"):
     frac_bits = 0 if plan.lossless else FRAC_BITS
     step_map = jnp.asarray(_step_map(plan)) if not plan.lossless else None
+    # The tile batch is staged fresh per dispatch and never read again
+    # on host after the launch; donating it caps HBM at one copy.
     return jax.jit(retrace.instrument(
         "frontend", partial(_frontend_body, plan, P, frac_bits, mode,
-                            step_map)))
+                            step_map)),
+        donate_argnums=donate_argnums_if_supported(0))
 
 
 @dataclass
@@ -286,6 +290,14 @@ def dispatch_frontend(plan: TilePlan, tiles: np.ndarray,
     the CX/D stage instead of packing bit-plane bitmaps."""
     if tiles.ndim == 3:
         tiles = tiles[..., None]
+    # Dtype audit at the host->device boundary: the device program's
+    # first op widens to int32/float32 anyway (pipeline._transform_batch),
+    # so an 8-byte host dtype would double or quadruple the transfer for
+    # nothing. Narrow before staging.
+    if tiles.dtype == np.int64:
+        tiles = tiles.astype(np.int32)
+    elif tiles.dtype == np.float64:
+        tiles = tiles.astype(np.float32)
     b = tiles.shape[0]
     pad = _bucket(b) - b
     if pad:

@@ -1,5 +1,6 @@
-"""Cross-request encode scheduler: continuous device batching + a shared
-multi-threaded host Tier-1 pool.
+"""Cross-request scheduler: continuous device batching for encodes, a
+shared multi-threaded host Tier-1 pool, and typed admission control for
+encode *and* decode (region-read) jobs.
 
 Before this module every encode request ran a private pipeline:
 ``encode_array`` spun up its own one-worker executor for host Tier-1 and
@@ -32,12 +33,19 @@ access and host Tier-1 capacity instead:
   ``Retry-After``. Single-image requests are prioritized over batch
   items, and each request can carry a deadline that expires both while
   queued and at chunk-dispatch boundaries.
+- **Typed jobs** — requests carry a ``kind`` (``"encode"`` |
+  ``"decode"``). Both kinds share the one bounded queue and slot pool
+  (one device, one host — the resources are shared, so the admission
+  bound must be too), but decode jobs skip the encode pipeline seam and
+  interactive tile reads (:data:`PRIORITY_READ`) outrank every encode,
+  so a deep-zoom viewer's 512² window is never starved behind a batch
+  ingest. :meth:`read` is the decode-typed entry.
 
-Observability (``set_metrics_sink``): ``encode.queue_wait`` (stage),
-``encode.batch_occupancy`` (value distribution: requests per device
-launch), and counters ``encode.admission_rejects``,
-``encode.device_launches``, ``encode.batched_tiles``,
-``encode.deadline_expired``.
+Observability (``set_metrics_sink``): ``encode.queue_wait`` /
+``decode.queue_wait`` (stages), ``encode.batch_occupancy`` (value
+distribution: requests per device launch), and counters
+``{encode,decode}.admission_rejects``, ``encode.device_launches``,
+``encode.batched_tiles``, ``{encode,decode}.deadline_expired``.
 
 The pipeline-mapping trade-off this implements — shared replicated
 workers per stage versus per-request pipelines, throughput vs latency —
@@ -61,6 +69,7 @@ import numpy as np
 
 LOG = logging.getLogger(__name__)
 
+PRIORITY_READ = -1       # interactive tile/region reads outrank encodes
 PRIORITY_SINGLE = 0      # interactive single-image requests
 PRIORITY_BATCH = 1       # CSV batch items yield to interactive traffic
 
@@ -74,10 +83,11 @@ class QueueFull(RuntimeError):
     """Admission rejected: the bounded request queue is at depth. The
     HTTP layer maps this to 503 + ``Retry-After: retry_after``."""
 
-    def __init__(self, depth: int, retry_after: float) -> None:
+    def __init__(self, depth: int, retry_after: float,
+                 kind: str = "encode") -> None:
         self.retry_after = retry_after
         super().__init__(
-            f"encode queue full ({depth} requests queued or running); "
+            f"{kind} queue full ({depth} requests queued or running); "
             f"retry after {retry_after:g}s")
 
 
@@ -91,6 +101,7 @@ class _Ticket:
     priority: int
     seq: int
     deadline: float | None            # absolute time.monotonic()
+    kind: str = "encode"              # metric namespace: encode | decode
     granted: threading.Event = field(default_factory=threading.Event)
     abandoned: bool = False           # expired while waiting
     closed: bool = False
@@ -254,17 +265,19 @@ class EncodeScheduler:
 
     # -- admission + slots ---------------------------------------------
 
-    def _admit(self, priority: int, deadline_s: float | None) -> _Ticket:
+    def _admit(self, priority: int, deadline_s: float | None,
+               kind: str = "encode") -> _Ticket:
         with self._lock:
             if self._admitted >= self.queue_depth:
-                self._count("encode.admission_rejects")
-                raise QueueFull(self.queue_depth, self.retry_after_s)
+                self._count(f"{kind}.admission_rejects")
+                raise QueueFull(self.queue_depth, self.retry_after_s,
+                                kind)
             self._admitted += 1
             if deadline_s is None:
                 deadline_s = self.default_deadline_s
             deadline = (time.monotonic() + deadline_s
                         if deadline_s else None)
-            t = _Ticket(priority, next(self._seq), deadline)
+            t = _Ticket(priority, next(self._seq), deadline, kind)
             if self._running < self.max_concurrent and not self._waiting:
                 self._running += 1
                 t.granted.set()
@@ -289,12 +302,12 @@ class EncodeScheduler:
                 if timeout <= 0:
                     with self._lock:
                         t.abandoned = True
-                    self._count("encode.deadline_expired")
+                    self._count(f"{t.kind}.deadline_expired")
                     raise DeadlineExceeded(
-                        "encode deadline expired while queued")
+                        f"{t.kind} deadline expired while queued")
             t.granted.wait(timeout)
         if self._sink is not None:
-            self._sink.record("encode.queue_wait",
+            self._sink.record(f"{t.kind}.queue_wait",
                               time.perf_counter() - t0)
 
     def _finish(self, t: _Ticket) -> None:
@@ -310,32 +323,53 @@ class EncodeScheduler:
     # -- the public encode surface -------------------------------------
 
     def submit(self, fn, *args, priority: int = PRIORITY_SINGLE,
-               deadline_s: float | None = None, **kwargs):
-        """Run ``fn(*args, **kwargs)`` as one admitted encode request:
-        wait for a slot (by priority, bounded by the deadline), then
-        execute with the encoder's device dispatch and host Tier-1
-        routed through this scheduler. Raises :class:`QueueFull`
-        without blocking when the bounded queue is at depth."""
+               deadline_s: float | None = None, kind: str = "encode",
+               **kwargs):
+        """Run ``fn(*args, **kwargs)`` as one admitted request: wait for
+        a slot (by priority, bounded by the deadline), then execute.
+        ``kind="encode"`` jobs run with the encoder's device dispatch
+        and host Tier-1 routed through this scheduler;
+        ``kind="decode"`` jobs (region/tile reads) share the same
+        bounded queue and slots and poll the deadline between Tier-1
+        code-blocks (t1_dec.decode_services) instead of the encode
+        pipeline seam.
+        Raises :class:`QueueFull` without blocking when the bounded
+        queue is at depth."""
         from ..codec import encoder as encoder_mod
 
-        ticket = self._admit(priority, deadline_s)
+        ticket = self._admit(priority, deadline_s, kind)
 
         def check() -> None:
             """Deadline hook the encoder polls at chunk-dispatch
             boundaries (codec/encoder.py pipeline_services)."""
             if ticket.expired():
-                self._count("encode.deadline_expired")
+                self._count(f"{ticket.kind}.deadline_expired")
                 raise DeadlineExceeded(
-                    "encode deadline expired mid-pipeline")
+                    f"{ticket.kind} deadline expired mid-pipeline")
 
         try:
             self._await_slot(ticket)
+            if kind != "encode":
+                from ..codec.decode import t1_dec
+                with t1_dec.decode_services(check=check):
+                    return fn(*args, **kwargs)
             with encoder_mod.pipeline_services(
                     dispatch=self.dispatch_frontend, pool=self._pool,
                     check=check):
                 return fn(*args, **kwargs)
         finally:
             self._finish(ticket)
+
+    def read(self, fn, *args, priority: int = PRIORITY_READ,
+             deadline_s: float | None = None, **kwargs):
+        """Run a decode/region-read job through the shared admission
+        queue at read priority: tile reads for interactive viewers are
+        granted slots before any queued encode, and past the bounded
+        queue the caller gets :class:`QueueFull` -> 503 + Retry-After
+        exactly like encode submissions."""
+        return self.submit(fn, *args, priority=priority,
+                           deadline_s=deadline_s, kind="decode",
+                           **kwargs)
 
     def encode_array(self, img, bitdepth: int = 8, params=None,
                      mesh=None, *, priority: int = PRIORITY_SINGLE,
@@ -505,6 +539,10 @@ class EncodeScheduler:
                     "max_concurrent": self.max_concurrent,
                     "pool_size": self.pool_size}
 
+
+# The class predates decode routing; the neutral name is the current
+# one, the encode-flavored name stays for existing callers.
+Scheduler = EncodeScheduler
 
 _GLOBAL: EncodeScheduler | None = None
 _GLOBAL_LOCK = threading.Lock()

@@ -33,6 +33,7 @@ from .. import models as m
 from ..codec.decode import DecodeError, InvalidParam
 from ..converters import TpuReader, available_converters, derivative_path
 from ..engine import Engine, start_job, update_item_status
+from ..engine.scheduler import DeadlineExceeded, QueueFull
 from ..engine.store import LockTimeout
 from ..engine.workers import IMAGE_WORKER
 from ..utils import path_prefix as pp
@@ -83,9 +84,13 @@ class Api:
         # per-launch batch occupancy and admission rejects into the
         # same registry, so /metrics shows the serving picture whole.
         get_scheduler().set_metrics_sink(self.metrics)
+        # Decode work is admitted through the same scheduler as encodes
+        # (typed read-priority jobs): tile reads share the bounded
+        # queue's 503 backpressure but outrank queued encodes, and the
+        # reader's cache hits bypass admission entirely.
         self.reader = TpuReader(
             cache_mb=engine.config.get_int(cfg.DECODE_CACHE_MB, -1),
-            metrics=self.metrics)
+            metrics=self.metrics, scheduler=get_scheduler())
         self._background: set[asyncio.Task] = set()
         # Image-mount path prefix (reference: MainVerticle.java:92-102
         # installs it on the JobFactory at boot).
@@ -156,10 +161,14 @@ class Api:
     async def get_image(self, request: web.Request) -> web.Response:
         """Decode the stored JP2/JPX derivative for an image id.
 
-        Query: ``reduce`` drops the finest resolution levels (a IIIF
-        thumbnail read — Tier-1 work for the skipped subbands never
-        happens), ``layers`` truncates at a quality layer, ``format``
-        is ``png`` (default) or ``raw`` (npy bytes for pipelines).
+        Query: ``region=x,y,w,h`` (or the IIIF aliases ``full`` /
+        ``square``) decodes only that full-resolution window — Tier-1
+        runs solely for the intersecting code-blocks; ``reduce`` drops
+        the finest resolution levels (a IIIF zoom-out), ``layers``
+        truncates at a quality layer, ``format`` is ``png`` (default)
+        or ``raw`` (npy bytes for pipelines). Region decodes are
+        admitted through the scheduler at read priority: past the
+        bounded queue the answer is 503 + Retry-After.
         """
         image_id = urllib.parse.unquote(request.match_info["image_id"])
         try:
@@ -176,18 +185,54 @@ class Api:
         path = derivative_path(image_id)
         if path is None:
             return _error_page(404, f"no derivative for: {image_id}")
+        region_q = request.query.get("region")
+        region = None
+        if region_q and region_q != "full":
+            if region_q == "square":
+                # IIIF `square`: the centered largest square. dims()
+                # hits the reader's file-identity cache after the
+                # first probe, so repeats don't re-read the file.
+                try:
+                    width, height = await asyncio.to_thread(
+                        self.reader.dims, path)
+                except DecodeError as exc:
+                    LOG.warning("decode failed for %s: %s",
+                                image_id, exc)
+                    self.metrics.count("decode.failures")
+                    return _error_page(500, f"decode failed: {exc}")
+                side = min(width, height)
+                region = ((width - side) // 2,
+                          (height - side) // 2, side, side)
+            else:
+                parts = region_q.split(",")
+                if len(parts) != 4:
+                    return _error_page(
+                        400, "region must be x,y,w,h or full or square")
+                try:
+                    region = tuple(int(v) for v in parts)
+                except ValueError:
+                    return _error_page(
+                        400, "region coordinates must be integers")
         self.metrics.count("decode.requests")
+        if region is not None:
+            self.metrics.count("decode.region_requests")
         if reduce or layers is not None:
             self.metrics.count("decode.partial_requests")
         try:
             with self.metrics.time("image_read"):
                 img = await asyncio.to_thread(
-                    self.reader.read, path, reduce, layers)
+                    self.reader.read, path, reduce, layers, region)
         except InvalidParam as exc:
             # The derivative is fine; the request asked for something
             # no stream could satisfy (e.g. reduce beyond the coded
-            # decomposition levels).
+            # decomposition levels, or a region outside the image).
             return _error_page(400, str(exc))
+        except (QueueFull, DeadlineExceeded) as exc:
+            retry_after = getattr(exc, "retry_after", 1)
+            return _error_page(
+                503, str(exc),
+                headers={"Retry-After":
+                         str(max(1, int(round(float(retry_after)))))})
         except DecodeError as exc:
             LOG.warning("decode failed for %s: %s", image_id, exc)
             self.metrics.count("decode.failures")

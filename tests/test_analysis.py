@@ -447,3 +447,74 @@ def test_cli_warnings_fail_only_in_strict(tmp_path):
         "void t1_extra(int n) {}\n}\n", encoding="utf-8")
     assert cli_main([str(native_pkg)]) == 0          # warning only
     assert cli_main([str(native_pkg), "--strict"]) == 1
+
+
+# --- missing-donation -------------------------------------------------
+
+def test_seeded_missing_donation(tmp_path):
+    root = _make_pkg(tmp_path, {"codec/frontend.py": """\
+        import jax
+
+
+        def _body(batch):
+            return batch * 2
+
+        _fn = jax.jit(_body)
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["missing-donation"]
+    assert "donate_argnums" in findings[0].message
+
+
+def test_donation_spec_is_clean(tmp_path):
+    root = _make_pkg(tmp_path, {"codec/decode/device.py": """\
+        import jax
+
+
+        def _body(batch):
+            return batch * 2
+
+        _fn = jax.jit(_body, donate_argnums=(0,))
+        _gn = jax.jit(_body, donate_argnames=("batch",))
+        """})
+    assert lint.run_lint(root) == []
+
+
+def test_donation_whitelist_and_scope(tmp_path):
+    root = _make_pkg(tmp_path, {
+        # `gather` is whitelisted by name: its rows buffer is re-read
+        # across chunked dispatches.
+        "codec/frontend.py": """\
+            import jax
+
+
+            def gather(rows, src):
+                return rows[src]
+
+            _fn = jax.jit(gather)
+            """,
+        # Out of scope: only the hot device modules are gated.
+        "codec/other.py": """\
+            import jax
+
+
+            def _body(x):
+                return x + 1
+
+            _fn = jax.jit(_body)
+            """})
+    assert _rules(lint.run_lint(root)) == []
+
+
+def test_repo_frontend_and_decode_device_donate():
+    """The real modules must stay clean under the rule — buffer
+    donation on the jitted front-end and decode inverse is the fix the
+    rule exists to keep in place."""
+    from pathlib import Path
+
+    import bucketeer_tpu
+
+    root = Path(bucketeer_tpu.__file__).parent
+    from bucketeer_tpu.analysis import rules_donation
+    project = lint.load_project(root)
+    assert rules_donation.run(project) == []

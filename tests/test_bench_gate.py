@@ -1,0 +1,102 @@
+"""bench_gate.py: the CI throughput regression gate's decision logic
+and JSON-line extraction."""
+import json
+import sys
+
+sys.path.insert(0, ".")          # bench_gate lives at the repo root
+import bench_gate  # noqa: E402
+
+
+def _rep(value, platform="cpu", **kw):
+    out = {"value": value, "unit": "MPix/s", "platform": platform,
+           "device_run_valid": True}
+    out.update(kw)
+    return out
+
+
+def test_within_tolerance_passes():
+    ok, msg = bench_gate.check(_rep(0.97), _rep(1.0), 5.0)
+    assert ok and "-" not in msg.split("(")[0]
+
+
+def test_loss_beyond_tolerance_fails():
+    ok, msg = bench_gate.check(_rep(0.90), _rep(1.0), 5.0)
+    assert not ok
+    assert "10.0% loss" in msg
+
+
+def test_faster_always_passes():
+    ok, _ = bench_gate.check(_rep(2.0), _rep(1.0), 5.0)
+    assert ok
+
+
+def test_platform_mismatch_skips():
+    ok, msg = bench_gate.check(_rep(0.01, platform="cpu"),
+                               _rep(100.0, platform="tpu"), 5.0)
+    assert ok and "mismatch" in msg
+
+
+def test_machine_mismatch_relaxes_threshold():
+    ref = _rep(1.0, machine={"arch": "x86_64", "cpu_count": 64})
+    # 20% loss: beyond the strict 5% limit but within the relaxed
+    # cross-machine one — passes with the mismatch note.
+    ok, msg = bench_gate.check(
+        _rep(0.8, machine={"arch": "x86_64", "cpu_count": 2}), ref, 5.0)
+    assert ok and "machine mismatch" in msg
+    # 50% loss: a halved pipeline fails even across machine classes.
+    cur = _rep(0.5, machine={"arch": "x86_64", "cpu_count": 2})
+    ok, msg = bench_gate.check(cur, ref, 5.0)
+    assert not ok and "limit 40%" in msg
+    # --force applies the strict threshold despite the mismatch.
+    ok, msg = bench_gate.check(cur, ref, 5.0, force=True)
+    assert not ok and "limit 5%" in msg
+
+
+def test_workload_smoke_mismatch_skips():
+    ok, msg = bench_gate.check(_rep(0.5, smoke=True),
+                               _rep(1.0, smoke=False), 5.0)
+    assert ok and "workload mismatch" in msg
+
+
+def test_same_machine_gates():
+    m = {"arch": "x86_64", "cpu_count": 4}
+    ok, _ = bench_gate.check(_rep(0.5, machine=m), _rep(1.0, machine=m),
+                             5.0)
+    assert not ok
+
+
+def test_invalid_device_run_never_gates_device_reference():
+    cur = _rep(1.0, platform="tpu", device_run_valid=False,
+               platform_fallback=True)
+    ok, msg = bench_gate.check(cur, _rep(100.0, platform="tpu"), 5.0)
+    assert ok and "invalid device run" in msg
+
+
+def test_missing_headline_value_fails():
+    ok, _ = bench_gate.check(_rep(0.0), _rep(1.0), 5.0)
+    assert not ok
+
+
+def test_empty_reference_skips():
+    ok, msg = bench_gate.check(_rep(1.0), _rep(0.0), 5.0)
+    assert ok and "skipped" in msg
+
+
+def test_load_report_takes_last_json_line(tmp_path):
+    p = tmp_path / "run.json"
+    p.write_text("# log noise\n" + json.dumps({"value": 1}) + "\n"
+                 + json.dumps({"value": 2, "platform": "cpu"}) + "\n")
+    assert bench_gate.load_report(str(p))["value"] == 2
+
+
+def test_main_exit_codes(tmp_path):
+    cur = tmp_path / "cur.json"
+    ref = tmp_path / "ref.json"
+    ref.write_text(json.dumps(_rep(1.0)) + "\n")
+    cur.write_text(json.dumps(_rep(0.98)) + "\n")
+    assert bench_gate.main([str(cur), str(ref)]) == 0
+    cur.write_text(json.dumps(_rep(0.5)) + "\n")
+    assert bench_gate.main([str(cur), str(ref)]) == 1
+    assert bench_gate.main([str(cur), str(ref),
+                            "--max-loss-pct=60"]) == 0
+    assert bench_gate.main([str(cur)]) == 2

@@ -108,3 +108,222 @@ def test_eviction_counter_reaches_metrics(tmp_path):
     reader.read(path_a)
     reader.read(path_b)
     assert sink.report()["counters"]["decode.cache_evictions"] >= 1
+
+
+# --- tiered cache: region keys + the stream-index tier ----------------
+
+def _write_region_jp2(tmp_path, name, size=64, seed=9):
+    import dataclasses
+
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+    params = dataclasses.replace(
+        EncodeParams.kakadu_recipe(lossless=True), tile_size=size,
+        levels=3)
+    data = encoder.encode_jp2(img, 8, params)
+    path = tmp_path / name
+    path.write_bytes(data)
+    return str(path), img
+
+
+def test_region_reads_have_their_own_tile_keys(tmp_path):
+    path, img = _write_region_jp2(tmp_path, "r.jp2")
+    sink = Metrics()
+    reader = TpuReader(cache_mb=4, metrics=sink)
+    a = reader.read(path, region=(0, 0, 16, 16))
+    b = reader.read(path, region=(16, 0, 16, 16))
+    assert np.array_equal(a, img[0:16, 0:16])
+    assert np.array_equal(b, img[0:16, 16:32])
+    assert np.array_equal(reader.read(path, region=(0, 0, 16, 16)), a)
+    counters = sink.report()["counters"]
+    assert counters["decode.cache_misses"] == 2
+    assert counters["decode.cache_hits"] == 1
+
+
+def test_clamp_equivalent_regions_share_one_tile_entry(tmp_path):
+    """The decoder clamps extents to the image, so an edge tile asked
+    for at a fixed nominal tile size and its pre-clamped twin are the
+    same pixels — the tile tier must serve one from the other instead
+    of decoding and storing both."""
+    path, img = _write_region_jp2(tmp_path, "cl.jp2")   # 64x64
+    sink = Metrics()
+    reader = TpuReader(cache_mb=4, metrics=sink)
+    a = reader.read(path, region=(48, 48, 32, 32))      # clamps to 16x16
+    b = reader.read(path, region=(48, 48, 16, 16))      # the clamped twin
+    assert np.array_equal(a, img[48:64, 48:64])
+    assert a is b                                       # one cache entry
+    counters = sink.report()["counters"]
+    assert counters["decode.cache_misses"] == 1
+    assert counters["decode.cache_hits"] == 1
+    # Reversed arrival order hits too (dims now known up front).
+    c = reader.read(path, region=(48, 48, 999, 999))
+    assert c is a
+    assert sink.report()["counters"]["decode.cache_hits"] == 2
+
+
+def test_index_tier_builds_once_per_file_identity(tmp_path):
+    path, _ = _write_region_jp2(tmp_path, "i.jp2")
+    sink = Metrics()
+    reader = TpuReader(cache_mb=4, metrics=sink)
+    reader.read(path, region=(0, 0, 16, 16))
+    reader.read(path, region=(16, 16, 16, 16))
+    reader.read(path, region=(32, 0, 16, 16))
+    rep = sink.report()
+    counters = rep["counters"]
+    assert counters["decode.index_cache_misses"] == 1
+    assert counters["decode.index_cache_hits"] == 2
+    assert rep["stages"]["decode.index_build"]["count"] == 1
+    # A rewritten derivative is a new identity: the index rebuilds.
+    path_b, _ = _write_region_jp2(tmp_path, "i2.jp2", seed=10)
+    os.replace(path_b, path)
+    os.utime(path, ns=(1, 1))
+    reader.read(path, region=(0, 0, 16, 16))
+    assert sink.report()["counters"]["decode.index_cache_misses"] == 2
+
+
+def test_index_tier_builds_are_single_flight(tmp_path, monkeypatch):
+    """Concurrent cold reads of one file pay for one index build: the
+    storm's other clients wait on the in-flight builder instead of
+    duplicating the header walk."""
+    import threading
+    import time as time_mod
+
+    from bucketeer_tpu.converters import reader as reader_mod
+
+    path, img = _write_region_jp2(tmp_path, "sf.jp2")
+    sink = Metrics()
+    reader = TpuReader(cache_mb=4, metrics=sink)
+    builds = []
+    real_build = reader_mod.build_index
+
+    def slow_build(data):
+        builds.append(threading.get_ident())
+        time_mod.sleep(0.2)
+        return real_build(data)
+
+    monkeypatch.setattr(reader_mod, "build_index", slow_build)
+    results = {}
+
+    def hit(i):
+        results[i] = reader.read(path, region=(0, 0, 16, 16))
+
+    threads = [threading.Thread(target=hit, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(builds) == 1
+    for arr in results.values():
+        assert np.array_equal(arr, img[0:16, 0:16])
+    counters = sink.report()["counters"]
+    assert counters["decode.index_cache_misses"] == 1
+    assert not reader._index_builds        # no leaked in-flight entries
+
+
+def test_index_waiter_honors_deadline_check(tmp_path, monkeypatch):
+    """A waiter parked behind a slow index builder polls the installed
+    decode-services check (the scheduler's deadline hook) instead of
+    holding its admitted slot for the whole fallback window."""
+    import threading
+    import time as time_mod
+
+    from bucketeer_tpu.codec.decode import t1_dec
+    from bucketeer_tpu.converters import reader as reader_mod
+
+    path, _ = _write_region_jp2(tmp_path, "dl.jp2")
+    reader = TpuReader(cache_mb=4)
+    real_build = reader_mod.build_index
+    started = threading.Event()
+
+    def slow_build(data):
+        started.set()
+        time_mod.sleep(3)
+        return real_build(data)
+
+    monkeypatch.setattr(reader_mod, "build_index", slow_build)
+
+    class Expired(Exception):
+        pass
+
+    def expired_check():
+        raise Expired()
+
+    errors = {}
+
+    def builder():
+        reader.read(path, region=(0, 0, 16, 16))
+
+    def waiter():
+        with t1_dec.decode_services(check=expired_check):
+            t0 = time_mod.monotonic()
+            try:
+                reader.read(path, region=(0, 0, 16, 16))
+            except Expired:
+                errors["waited"] = time_mod.monotonic() - t0
+
+    tb = threading.Thread(target=builder)
+    tb.start()
+    assert started.wait(timeout=10)
+    tw = threading.Thread(target=waiter)
+    tw.start()
+    tw.join(timeout=10)
+    tb.join(timeout=30)
+    assert "waited" in errors          # the check fired, not a timeout
+    assert errors["waited"] < 2        # well before the builder's 3 s
+
+
+def test_dims_probes_once_per_file_identity(tmp_path, monkeypatch):
+    from bucketeer_tpu.converters import reader as reader_mod
+
+    path, img = _write_region_jp2(tmp_path, "dm.jp2")
+    reader = TpuReader(cache_mb=4)
+    calls = []
+    real_probe = reader_mod._probe
+
+    def counting_probe(data):
+        calls.append(1)
+        return real_probe(data)
+
+    monkeypatch.setattr(reader_mod, "_probe", counting_probe)
+    assert reader.dims(path) == (img.shape[1], img.shape[0])
+    assert reader.dims(path) == (img.shape[1], img.shape[0])
+    assert len(calls) == 1
+    # A region read shares the same dims cache: still no re-probe.
+    reader.read(path, region=(0, 0, 16, 16))
+    assert len(calls) == 1
+
+
+def test_index_tier_entry_bound_evicts(tmp_path):
+    sink = Metrics()
+    reader = TpuReader(cache_mb=4, metrics=sink, index_entries=2)
+    paths = [
+        _write_region_jp2(tmp_path, f"e{i}.jp2", seed=20 + i)[0]
+        for i in range(3)]
+    for p in paths:
+        reader.read(p, region=(0, 0, 16, 16))
+    counters = sink.report()["counters"]
+    assert counters["decode.index_cache_evictions"] == 1
+    # The evicted (oldest) index rebuilds on the next read.
+    reader.read(paths[0], region=(16, 0, 16, 16))
+    assert sink.report()["counters"]["decode.index_cache_misses"] == 4
+
+
+def test_full_reads_skip_the_index_tier(tmp_path):
+    path, _ = _write_region_jp2(tmp_path, "f.jp2")
+    sink = Metrics()
+    reader = TpuReader(cache_mb=4, metrics=sink)
+    reader.read(path)
+    counters = sink.report()["counters"]
+    assert "decode.index_cache_misses" not in counters
+
+
+def test_reset_caches_drops_tiles_keeps_index(tmp_path):
+    path, _ = _write_region_jp2(tmp_path, "z.jp2")
+    sink = Metrics()
+    reader = TpuReader(cache_mb=4, metrics=sink)
+    reader.read(path, region=(0, 0, 16, 16))
+    reader.reset_caches(tiles=True, index=False)
+    reader.read(path, region=(0, 0, 16, 16))
+    counters = sink.report()["counters"]
+    assert counters["decode.cache_misses"] == 2     # tile re-decoded
+    assert counters["decode.index_cache_hits"] == 1  # index survived

@@ -1,0 +1,301 @@
+"""Random-access region decode: bit-exact-crop parity across the
+coding-option matrix, the only-intersecting-blocks invariant (metrics
+backed), the stream index (PLT and walk builds, indexed == sequential),
+and the typed rejection of malformed region parameters.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from bucketeer_tpu.codec import encoder
+from bucketeer_tpu.codec.decode import (InvalidParam, build_index, decode,
+                                        set_metrics_sink)
+from bucketeer_tpu.codec.decode import index as sindex
+from bucketeer_tpu.codec.decode import parser
+from bucketeer_tpu.codec.encoder import EncodeParams
+from bucketeer_tpu.server.metrics import Metrics
+
+
+def _img(rng, h, w, comps=3, depth=8):
+    hi = (1 << depth) - 1
+    dtype = np.uint8 if depth <= 8 else np.uint16
+    shape = (h, w) if comps == 1 else (h, w, comps)
+    return rng.integers(0, hi + 1, shape, dtype=dtype)
+
+
+REGIONS = [(0, 0, 33, 33), (17, 9, 40, 23), (31, 37, 9, 50),
+           (60, 60, 500, 500)]
+
+
+@pytest.mark.parametrize("comps,depth,lossless,tile,levels", [
+    (3, 8, True, 64, 3),          # RGB lossless, multi-tile
+    (3, 8, False, 64, 3),         # RGB lossy 9/7, multi-tile
+    (1, 8, True, None, 3),        # grayscale single tile
+    (1, 16, True, 96, 2),         # 16-bit, straddle-96 banding
+    (3, 8, False, None, 4),       # lossy single tile, deeper pyramid
+])
+def test_region_bit_exact_vs_full_crop(rng, comps, depth, lossless,
+                                       tile, levels):
+    img = _img(rng, 80, 96, comps, depth)
+    params = EncodeParams(lossless=lossless, levels=levels,
+                          tile_size=tile, base_delta=2.0)
+    data = encoder.encode_jp2(img, depth, params)
+    full = decode(data)
+    for region in REGIONS:
+        got = decode(data, region=region)
+        x, y, w, h = region
+        want = full[y:min(y + h, 80), x:min(x + w, 96)]
+        assert got.shape == want.shape
+        assert np.array_equal(got, want), (region, lossless, tile)
+
+
+@pytest.mark.parametrize("reduce", [0, 1, 2])
+def test_region_with_reduce_matches_reduced_crop(rng, reduce):
+    img = _img(rng, 80, 96)
+    params = EncodeParams(lossless=True, levels=3, tile_size=64)
+    data = encoder.encode_jp2(img, 8, params)
+    full = decode(data, reduce=reduce)
+    s = 1 << reduce
+    for region in [(17, 9, 40, 23), (64, 64, 48, 32)]:
+        x, y, w, h = region
+        got = decode(data, region=region, reduce=reduce)
+        want = full[y // s:-(-min(y + h, 80) // s),
+                    x // s:-(-min(x + w, 96) // s)]
+        assert np.array_equal(got, want), (region, reduce)
+
+
+def test_region_with_layers_matches_layered_crop(rng):
+    img = _img(rng, 96, 96)
+    params = EncodeParams(lossless=False, levels=3, tile_size=96,
+                          n_layers=4, base_delta=2.0, rate=2.0)
+    data = encoder.encode_jp2(img, 8, params)
+    for layers in (1, 2, None):
+        full = decode(data, layers=layers)
+        got = decode(data, region=(10, 20, 50, 40), layers=layers)
+        assert np.array_equal(got, full[20:60, 10:60])
+
+
+def test_region_kakadu_recipe_all_tiles(rng):
+    """The reference recipe end to end (RPCL, SOP/EPH/PLT, R
+    tile-parts, 6 layers): every aligned tile of a multi-tile lossy
+    stream reconstructs bit-exactly through the region path."""
+    img = _img(rng, 128, 128)
+    params = dataclasses.replace(
+        EncodeParams.kakadu_recipe(lossless=False, rate=3.0),
+        tile_size=64, levels=3)
+    data = encoder.encode_jp2(img, 8, params)
+    full = decode(data)
+    for y in range(0, 128, 64):
+        for x in range(0, 128, 64):
+            got = decode(data, region=(x, y, 64, 64))
+            assert np.array_equal(got, full[y:y + 64, x:x + 64]), (x, y)
+
+
+# --- only intersecting code-blocks run Tier-1 -------------------------
+
+def test_small_region_decodes_under_10pct_of_blocks(rng):
+    """The acceptance invariant at scale: a 96² window of a 2048² image
+    MQ-decodes <10% of the stream's code-blocks (metrics-backed via the
+    decode.blocks counter; the full count comes from the Tier-2 parse,
+    no full decode needed)."""
+    img = _img(rng, 2048, 2048, comps=1)
+    params = EncodeParams(lossless=False, levels=6, tile_size=None,
+                          base_delta=2.0, rate=1.0)
+    data = encoder.encode_jp2(img, 8, params)
+    ps = parser.parse(data)
+    total_blocks = sum(
+        len(band.blocks)
+        for tile in ps.tiles
+        for resolutions in tile.comp_res
+        for bands in resolutions
+        for band in bands)
+    sink = Metrics()
+    set_metrics_sink(sink)
+    try:
+        decode(data, region=(0, 0, 96, 96))
+    finally:
+        set_metrics_sink(None)
+    counters = sink.report()["counters"]
+    region_blocks = counters["decode.region_blocks"]
+    assert counters["decode.blocks"] == region_blocks
+    assert region_blocks < 0.10 * total_blocks, (
+        region_blocks, total_blocks)
+
+
+def test_region_block_counter_scales_with_window(rng):
+    """Fast-size version of the invariant: the 64²-of-512² region
+    touches a small fraction of the blocks and strictly fewer than the
+    full-window region (the counter is the one the acceptance test and
+    dashboards read)."""
+    img = _img(rng, 512, 512, comps=1)
+    params = EncodeParams(lossless=False, levels=4, tile_size=None,
+                          base_delta=2.0, rate=1.0)
+    data = encoder.encode_jp2(img, 8, params)
+    ps = parser.parse(data)
+    total_blocks = sum(
+        len(band.blocks)
+        for tile in ps.tiles
+        for resolutions in tile.comp_res
+        for bands in resolutions
+        for band in bands)
+
+    def blocks_for(region):
+        sink = Metrics()
+        set_metrics_sink(sink)
+        try:
+            decode(data, region=region)
+        finally:
+            set_metrics_sink(None)
+        return sink.report()["counters"]["decode.region_blocks"]
+
+    small = blocks_for((0, 0, 64, 64))
+    big = blocks_for((0, 0, 512, 512))
+    assert big == total_blocks        # full window == every block
+    assert small < 0.45 * total_blocks
+    assert small < big
+
+
+def test_indexed_region_skips_nonintersecting_packets(rng):
+    img = _img(rng, 128, 128)
+    params = dataclasses.replace(
+        EncodeParams.kakadu_recipe(lossless=True), tile_size=64,
+        levels=3)
+    data = encoder.encode_jp2(img, 8, params)
+    idx = build_index(data)
+    sink = Metrics()
+    set_metrics_sink(sink)
+    try:
+        decode(data, region=(0, 0, 32, 32), index=idx)
+    finally:
+        set_metrics_sink(None)
+    rep = sink.report()
+    counters = rep["counters"]
+    # Three of four tiles contribute nothing; their packets are never
+    # header-parsed, let alone body-read.
+    assert counters["decode.packets_skipped"] > idx.n_packets / 2
+    parsed = rep["stages"]["decode.t2_parse"]["items"]
+    assert parsed + counters["decode.packets_skipped"] == idx.n_packets
+
+
+# --- the stream index -------------------------------------------------
+
+def test_plt_and_walk_index_agree(rng):
+    """The PLT arithmetic and the tag-tree walk must land on identical
+    packet offsets — same stream, two build paths."""
+    img = _img(rng, 96, 96)
+    params = dataclasses.replace(
+        EncodeParams.kakadu_recipe(lossless=True), tile_size=64,
+        levels=3)
+    data = encoder.encode_jp2(img, 8, params)
+    idx_plt = build_index(data)
+    assert idx_plt.source == "plt"
+    ps = parser.parse(bytes(data), collect_index=True)
+    assert idx_plt.packets == ps.packet_index
+    assert idx_plt.tile_spans == ps.tile_spans
+
+
+def test_walk_index_used_without_plt(rng):
+    img = _img(rng, 80, 80)
+    params = EncodeParams(lossless=True, levels=3, tile_size=80)
+    data = encoder.encode_jp2(img, 8, params)
+    idx = build_index(data)
+    assert idx.source == "walk"
+    full = decode(data)
+    got = decode(data, region=(5, 5, 40, 40), index=idx)
+    assert np.array_equal(got, full[5:45, 5:45])
+
+
+def test_out_of_order_zplt_falls_back_to_walk(rng):
+    """T.800 lets PLT segments be stored out of Zplt order; naive
+    concatenation would permute the offsets without tripping the
+    count/sum consistency checks. A non-sequential Zplt must send the
+    build to the walk path, not produce a wrong index."""
+    img = _img(rng, 96, 96)
+    params = dataclasses.replace(
+        EncodeParams.kakadu_recipe(lossless=True), tile_size=64,
+        levels=3)
+    data = bytearray(encoder.encode_jp2(img, 8, params))
+    pos = bytes(data).find(b"\xff\x58")      # first PLT marker
+    assert pos > 0
+    assert data[pos + 4] == 0                # Zplt of the first segment
+    data[pos + 4] = 7                        # claim it is segment 7
+    idx = build_index(bytes(data))
+    assert idx.source == "walk"
+    full = decode(bytes(data))
+    got = decode(bytes(data), region=(5, 5, 40, 40), index=idx)
+    assert np.array_equal(got, full[5:45, 5:45])
+
+
+@pytest.mark.parametrize("progression", [0, 1, 2, 3, 4])
+def test_indexed_decode_matches_sequential_all_progressions(
+        rng, progression):
+    img = _img(rng, 80, 80)
+    params = EncodeParams(lossless=True, levels=2, tile_size=80,
+                          n_layers=2, progression=progression,
+                          gen_plt=True)
+    data = encoder.encode_jp2(img, 8, params)
+    idx = build_index(data)
+    full = decode(data)
+    for region in [(0, 0, 30, 30), (41, 33, 39, 47)]:
+        x, y, w, h = region
+        a = decode(data, region=region)
+        b = decode(data, region=region, index=idx)
+        assert np.array_equal(a, full[y:y + h, x:x + w])
+        assert np.array_equal(a, b)
+
+
+def test_index_nbytes_is_small(rng):
+    img = _img(rng, 96, 96)
+    params = dataclasses.replace(
+        EncodeParams.kakadu_recipe(lossless=True), tile_size=64,
+        levels=3)
+    data = encoder.encode_jp2(img, 8, params)
+    idx = build_index(data)
+    assert idx.nbytes < max(4 * len(data), 1 << 20)
+    assert idx.n_packets == sum(len(v) for v in idx.packets.values())
+
+
+def test_skeleton_carries_stream_parameters(rng):
+    img = _img(rng, 80, 80)
+    params = EncodeParams(lossless=True, levels=2, tile_size=80)
+    data = encoder.encode_jp2(img, 8, params)
+    idx = build_index(data)
+    sk = sindex.skeleton(idx)
+    assert (sk.width, sk.height) == (80, 80)
+    assert sk.levels == 2 and sk.reversible
+    assert sk.tiles == []
+
+
+# --- malformed region parameters --------------------------------------
+
+@pytest.mark.parametrize("region", [
+    (-1, 0, 10, 10),              # negative origin
+    (0, -3, 10, 10),
+    (200, 0, 10, 10),             # origin beyond width
+    (0, 200, 10, 10),             # origin beyond height
+    (0, 0, 0, 10),                # zero extent
+    (0, 0, 10, 0),
+    (0, 0, -5, 10),               # negative extent
+    ("a", 0, 10, 10),             # non-integer
+    (1.5, 0, 10, 10),             # non-integral float
+    (0, 0, 10),                   # wrong arity
+    (None, None, None, None),
+])
+def test_bad_region_raises_invalid_param(rng, region):
+    img = _img(rng, 96, 96, comps=1)
+    data = encoder.encode_jp2(
+        img, 8, EncodeParams(lossless=True, levels=2, tile_size=96))
+    with pytest.raises(InvalidParam):
+        decode(data, region=region)
+
+
+def test_region_beyond_levels_reduce_raises(rng):
+    img = _img(rng, 64, 64, comps=1)
+    data = encoder.encode_jp2(
+        img, 8, EncodeParams(lossless=True, levels=2, tile_size=64))
+    with pytest.raises(InvalidParam):
+        decode(data, region=(0, 0, 8, 8), reduce=5)
+    idx = build_index(data)
+    with pytest.raises(InvalidParam):
+        decode(data, region=(0, 0, 8, 8), reduce=5, index=idx)

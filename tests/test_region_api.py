@@ -1,0 +1,270 @@
+"""The region-read product surface: GET /images/{id}?region=..., the
+IIIF aliases, typed 400s for malformed region params, scheduler-routed
+read admission (503 + Retry-After past the bounded queue), and the
+read-over-batch priority guarantee.
+"""
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bucketeer_tpu import config as cfg
+from bucketeer_tpu import features
+from bucketeer_tpu.codec import encoder as codec_encoder
+from bucketeer_tpu.codec.encoder import EncodeParams
+from bucketeer_tpu.converters import output_path
+from bucketeer_tpu.engine import Engine, FakeS3Client, RecordingSlackClient
+from bucketeer_tpu.engine.scheduler import (PRIORITY_BATCH, PRIORITY_READ,
+                                            DeadlineExceeded, QueueFull,
+                                            Scheduler)
+from bucketeer_tpu.server.app import build_app
+
+
+@pytest.fixture
+def env_client(tmp_path, aiohttp_client):
+    """(http client, engine) factory — the test_api harness, local to
+    this module (fixtures don't import across test files)."""
+
+    async def factory():
+        config = cfg.Config.load(overrides={
+            cfg.IIIF_URL: "http://iiif.test/iiif",
+            cfg.SLACK_CHANNEL_ID: "chan",
+            cfg.FILESYSTEM_CSV_MOUNT: str(tmp_path / "csv-mount"),
+        })
+        engine = Engine(
+            config,
+            flags=features.FeatureFlagChecker(static={}),
+            converter=None,
+            s3_client=FakeS3Client(str(tmp_path / "s3")),
+            slack_client=RecordingSlackClient())
+        app = build_app(engine, job_delete_timeout=0.1)
+        client = await aiohttp_client(app)
+        return client, engine
+
+    return factory
+
+
+def _write_derivative(tmp_path, monkeypatch, image_id="ark:/9/region",
+                      size=64):
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    rng = np.random.default_rng(11)
+    img = rng.integers(0, 256, size=(size, size, 3)).astype(np.uint8)
+    data = codec_encoder.encode_jp2(
+        img, 8, EncodeParams(lossless=True, levels=2, tile_size=size,
+                             gen_plt=True), jpx=True)
+    with open(output_path(image_id, ".jpx"), "wb") as fh:
+        fh.write(data)
+    return img
+
+
+async def test_get_image_region_crop(tmp_path, env_client, monkeypatch):
+    img = _write_derivative(tmp_path, monkeypatch)
+    client, _ = await env_client()
+    resp = await client.get(
+        "/images/ark%3A%2F9%2Fregion?region=8,16,24,20&format=raw")
+    assert resp.status == 200
+    got = np.load(io.BytesIO(await resp.read()))
+    np.testing.assert_array_equal(got, img[16:36, 8:32])
+
+    # Aliases: full == no region; square of a square == full frame.
+    full = np.load(io.BytesIO(await (await client.get(
+        "/images/ark%3A%2F9%2Fregion?region=full&format=raw")).read()))
+    np.testing.assert_array_equal(full, img)
+    square = np.load(io.BytesIO(await (await client.get(
+        "/images/ark%3A%2F9%2Fregion?region=square&format=raw")).read()))
+    np.testing.assert_array_equal(square, img)
+
+    # region composes with reduce.
+    resp = await client.get(
+        "/images/ark%3A%2F9%2Fregion?region=0,0,32,32&reduce=1"
+        "&format=raw")
+    assert resp.status == 200
+    assert np.load(io.BytesIO(await resp.read())).shape == (16, 16, 3)
+
+    metrics = await (await client.get("/metrics")).json()
+    # `region=full` is the no-window alias and does not count.
+    assert metrics["counters"]["decode.region_requests"] >= 3
+    assert metrics["counters"]["decode.region_blocks"] >= 1
+    assert "decode.index_build" in metrics["stages"]
+
+
+@pytest.mark.parametrize("query", [
+    "region=1,2,3",               # wrong arity
+    "region=1,2,3,4,5",
+    "region=a,0,10,10",           # non-integer
+    "region=1.5,0,10,10",
+    "region=,,,",
+    "region=0,0,0,10",            # zero area
+    "region=0,0,10,0",
+    "region=0,0,-5,10",           # negative extent
+    "region=-1,0,10,10",          # negative origin
+    "region=9999,0,10,10",        # origin beyond the image
+    "region=0,9999,10,10",
+])
+async def test_get_image_bad_region_400(tmp_path, env_client,
+                                        monkeypatch, query):
+    _write_derivative(tmp_path, monkeypatch, image_id="bad-region")
+    client, _ = await env_client()
+    resp = await client.get(f"/images/bad-region?{query}")
+    assert resp.status == 400, query
+
+
+async def test_get_image_region_503_past_bounded_queue(
+        tmp_path, env_client, monkeypatch):
+    """Reads flow through the scheduler: with the queue saturated by a
+    stuck job, a cache-cold region read is rejected with 503 and a
+    Retry-After hint instead of piling on."""
+    _write_derivative(tmp_path, monkeypatch, image_id="busy-region")
+    client, _ = await env_client()
+    api = client.app["api"]
+    sched = Scheduler(queue_depth=1, max_concurrent=1,
+                      retry_after_s=3.0)
+    api.reader.scheduler = sched
+    release = threading.Event()
+    started = threading.Event()
+
+    def stuck():
+        started.set()
+        release.wait(10)
+
+    t = threading.Thread(target=sched.submit, args=(stuck,), daemon=True)
+    t.start()
+    try:
+        assert started.wait(5)
+        resp = await client.get(
+            "/images/busy-region?region=0,0,16,16&format=raw")
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+    finally:
+        release.set()
+        t.join(timeout=5)
+        sched.close()
+
+
+async def test_get_image_cache_hit_bypasses_admission(
+        tmp_path, env_client, monkeypatch):
+    """A decoded-tile cache hit must not need a scheduler slot — the
+    warm path stays up even when the queue is saturated."""
+    _write_derivative(tmp_path, monkeypatch, image_id="warm-region")
+    client, _ = await env_client()
+    api = client.app["api"]
+    resp = await client.get(
+        "/images/warm-region?region=0,0,16,16&format=raw")
+    assert resp.status == 200
+    warm = await resp.read()
+
+    sched = Scheduler(queue_depth=1, max_concurrent=1)
+    api.reader.scheduler = sched
+    release = threading.Event()
+
+    def stuck():
+        release.wait(10)
+
+    t = threading.Thread(target=sched.submit, args=(stuck,), daemon=True)
+    t.start()
+    try:
+        time.sleep(0.05)
+        resp = await client.get(
+            "/images/warm-region?region=0,0,16,16&format=raw")
+        assert resp.status == 200
+        assert await resp.read() == warm
+    finally:
+        release.set()
+        t.join(timeout=5)
+        sched.close()
+
+
+# --- scheduler-level guarantees the endpoint relies on ----------------
+
+def test_reads_outrank_queued_batch_encodes():
+    """The priority test: with one slot held and a line of batch jobs
+    waiting, a later-arriving read is granted the next slot before any
+    of them."""
+    sched = Scheduler(max_concurrent=1, queue_depth=16)
+    order = []
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocker():
+        started.set()
+        release.wait(10)
+
+    def job(tag):
+        order.append(tag)
+
+    threads = [threading.Thread(
+        target=sched.submit, args=(blocker,), daemon=True)]
+    threads[0].start()
+    assert started.wait(5)
+    for i in range(3):
+        th = threading.Thread(
+            target=sched.submit, args=(job, f"batch{i}"),
+            kwargs={"priority": PRIORITY_BATCH}, daemon=True)
+        th.start()
+        threads.append(th)
+    time.sleep(0.1)                  # batch jobs are queued first
+    th = threading.Thread(
+        target=sched.read, args=(job, "read"), daemon=True)
+    th.start()
+    threads.append(th)
+    time.sleep(0.1)
+    release.set()
+    for th in threads:
+        th.join(timeout=5)
+    sched.close()
+    assert order[0] == "read", order
+    assert sorted(order[1:]) == ["batch0", "batch1", "batch2"]
+
+
+def test_read_priority_constant_outranks_all():
+    assert PRIORITY_READ < 0 <= PRIORITY_BATCH
+
+
+def test_decode_jobs_share_bounded_queue_and_counters():
+    from bucketeer_tpu.server.metrics import Metrics
+
+    sched = Scheduler(max_concurrent=1, queue_depth=1)
+    sink = Metrics()
+    sched.set_metrics_sink(sink)
+    release = threading.Event()
+    started = threading.Event()
+
+    def stuck():
+        started.set()
+        release.wait(10)
+
+    t = threading.Thread(target=sched.submit, args=(stuck,), daemon=True)
+    t.start()
+    assert started.wait(5)
+    with pytest.raises(QueueFull):
+        sched.read(lambda: None)
+    release.set()
+    t.join(timeout=5)
+    sched.close()
+    counters = sink.report()["counters"]
+    assert counters["decode.admission_rejects"] == 1
+
+    # Deadline expiry is namespaced per kind too (room in the queue so
+    # the read is admitted and then expires waiting for the held slot).
+    sched2 = Scheduler(max_concurrent=1, queue_depth=4)
+    sched2.set_metrics_sink(sink)
+    release2 = threading.Event()
+    started2 = threading.Event()
+
+    def stuck2():
+        started2.set()
+        release2.wait(10)
+
+    t2 = threading.Thread(target=sched2.submit, args=(stuck2,),
+                          daemon=True)
+    t2.start()
+    assert started2.wait(5)
+    with pytest.raises(DeadlineExceeded):
+        sched2.read(lambda: None, deadline_s=0.05)
+    release2.set()
+    t2.join(timeout=5)
+    sched2.close()
+    counters = sink.report()["counters"]
+    assert counters["decode.deadline_expired"] == 1
