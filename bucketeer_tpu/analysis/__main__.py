@@ -1,7 +1,22 @@
-"""graftlint CLI: ``python -m bucketeer_tpu.analysis [--strict] [paths]``.
+"""graftlint CLI: ``python -m bucketeer_tpu.analysis [--strict]
+[--audit] [paths]``.
 
 Exit codes: 0 clean (in non-strict mode, warnings alone stay clean),
 1 findings, 2 bad invocation.
+
+``--audit`` adds the compiled-artifact layer (deviceaudit): every
+registered jitted entry point is lowered on the current backend (CPU is
+enough — no device needed) and verified for donation effectiveness,
+in-program host round-trips and f64 leakage, then the program manifest
+(``.graftaudit-manifest.json``) is diffed against the checked-in file.
+After an intentional program change, regenerate it with
+``--write-manifest`` and commit the result — the diff in review *is*
+the compiled-program change.
+
+Suppression hygiene is always on: a ``# graftlint: disable=`` comment
+or a baseline entry that no longer suppresses any live finding is a
+warning (so ``--strict`` fails on it); ``--prune-baseline`` rewrites
+the baseline file keeping only live entries.
 """
 from __future__ import annotations
 
@@ -11,15 +26,18 @@ import sys
 from pathlib import Path
 
 from .findings import ERROR
-from .lint import load_baseline, run_lint, write_baseline
+from .lint import (STALE_BASELINE, Finding, load_baseline, prune_baseline,
+                   run_lint, write_baseline)
 
 DEFAULT_BASELINE = ".graftlint-baseline.json"
+DEFAULT_MANIFEST = ".graftaudit-manifest.json"
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m bucketeer_tpu.analysis",
-        description="JAX/TPU-aware lint for the bucketeer codebase")
+        description="JAX/TPU-aware lint + compiled-artifact audit for "
+                    "the bucketeer codebase")
     parser.add_argument("paths", nargs="*",
                         help="package directories to lint (default: the "
                              "installed bucketeer_tpu package)")
@@ -31,6 +49,24 @@ def main(argv=None) -> int:
     parser.add_argument("--write-baseline", action="store_true",
                         help="record current findings as the baseline "
                              "and exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="rewrite the baseline dropping entries that "
+                             "no longer suppress a live finding")
+    parser.add_argument("--audit", action="store_true",
+                        help="also lower every registered jitted entry "
+                             "point and audit the compiled artifacts "
+                             "(donation aliasing, host round-trips, "
+                             "f64, manifest drift)")
+    parser.add_argument("--manifest", default=None,
+                        help="program manifest file (default: "
+                             f"{DEFAULT_MANIFEST} next to the package)")
+    parser.add_argument("--write-manifest", action="store_true",
+                        help="regenerate the program manifest from the "
+                             "current lowered programs and exit 0")
+    parser.add_argument("--dump-dir", default=None,
+                        help="on audit failure, write every lowered "
+                             "program's StableHLO here (CI uploads it "
+                             "as an artifact)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable output")
     args = parser.parse_args(argv)
@@ -49,17 +85,52 @@ def main(argv=None) -> int:
     # covers every linted root.
     baseline_path = (Path(args.baseline) if args.baseline
                      else roots[0].parent / DEFAULT_BASELINE)
+    manifest_path = (Path(args.manifest) if args.manifest
+                     else roots[0].parent / DEFAULT_MANIFEST)
+
+    if args.write_manifest:
+        from . import deviceaudit
+        _, manifest, facts = deviceaudit.run_audit(manifest_path)
+        deviceaudit.write_manifest(manifest_path, manifest)
+        print(f"wrote {len(manifest['programs'])} lowered program(s) "
+              f"to {manifest_path}")
+        for f in facts:
+            if f.skipped:
+                print(f"  skipped {f.name}: {f.skipped}")
+        return 0
+
     baseline = (set() if args.write_baseline
                 else load_baseline(baseline_path)
                 if baseline_path.exists() else set())
+    used_baseline: set = set()
     findings = []
     for root in roots:
-        findings += run_lint(root, baseline=baseline)
+        findings += run_lint(root, baseline=baseline,
+                             used_baseline=used_baseline)
 
     if args.write_baseline:
         write_baseline(baseline_path, findings)
         print(f"wrote {len(findings)} finding(s) to {baseline_path}")
         return 0
+
+    stale = baseline - used_baseline
+    if stale and args.prune_baseline:
+        dropped = prune_baseline(baseline_path, used_baseline)
+        print(f"pruned {dropped} stale entr{'y' if dropped == 1 else 'ies'} "
+              f"from {baseline_path}")
+    elif stale:
+        for fp in sorted(stale):
+            findings.append(Finding(
+                STALE_BASELINE, str(baseline_path), 1,
+                f"baseline fingerprint {fp} matches no live finding — "
+                "prune it with --prune-baseline", "warning"))
+
+    if args.audit:
+        from . import deviceaudit
+        audit_findings, _, _ = deviceaudit.run_audit(
+            manifest_path, package_root=roots[0],
+            dump_dir=args.dump_dir)
+        findings += audit_findings
 
     if args.as_json:
         print(json.dumps([{
@@ -78,7 +149,8 @@ def main(argv=None) -> int:
     if errors or (args.strict and warnings):
         return 1
     if not findings and not args.as_json:
-        print("graftlint: clean")
+        print("graftlint: clean" + (" (audit passed)" if args.audit
+                                    else ""))
     return 0
 
 

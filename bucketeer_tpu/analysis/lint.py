@@ -20,10 +20,13 @@ import re
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from .findings import ERROR, Finding
+from .findings import ERROR, WARNING, Finding
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,\-]+)")
 _DISABLE_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([\w,\-]+)")
+
+STALE_SUPPRESSION = "stale-suppression"
+STALE_BASELINE = "stale-baseline-entry"
 
 
 @dataclass
@@ -146,12 +149,46 @@ def _suppressions(mod: ModuleInfo):
     return per_line, file_wide
 
 
-def _suppressed(finding: Finding, per_line: dict, file_wide: set) -> bool:
-    for rules in (file_wide, per_line.get(finding.line, ()),
-                  per_line.get(finding.line - 1, ())):
-        if rules and (finding.rule in rules or "all" in rules):
-            return True
-    return False
+def _suppression_hit(finding: Finding, per_line: dict, file_wide: set):
+    """The suppression that absorbs this finding, or None.
+
+    Returns ``("file", rule)`` for a file-wide disable or
+    ``("line", lineno, rule)`` for an inline one — the key the staleness
+    pass marks as *used*, so disables that stop matching anything are
+    themselves reported (suppressions are sanctioned exceptions; a
+    stale one is a hole waiting for a new bug to walk through)."""
+    for rule in (finding.rule, "all"):
+        if rule in file_wide:
+            return ("file", rule)
+    for lineno in (finding.line, finding.line - 1):
+        rules = per_line.get(lineno, ())
+        for rule in (finding.rule, "all"):
+            if rule in rules:
+                return ("line", lineno, rule)
+    return None
+
+
+def _stale_suppression_findings(by_relpath: dict, suppressions: dict,
+                                used: set) -> list:
+    out = []
+    for relpath, mod in by_relpath.items():
+        per_line, file_wide = suppressions[relpath]
+        for lineno in sorted(per_line):
+            for rule in sorted(per_line[lineno]):
+                if (relpath, "line", lineno, rule) not in used:
+                    out.append(Finding(
+                        STALE_SUPPRESSION, relpath, lineno,
+                        f"'# graftlint: disable={rule}' suppresses no "
+                        "live finding — remove the stale disable",
+                        WARNING, mod.source_line(lineno)))
+        for rule in sorted(file_wide):
+            if (relpath, "file", rule) not in used:
+                out.append(Finding(
+                    STALE_SUPPRESSION, relpath, 1,
+                    f"'# graftlint: disable-file={rule}' suppresses no "
+                    "live finding in this file — remove it",
+                    WARNING, mod.source_line(1)))
+    return out
 
 
 def load_baseline(path: Path) -> set:
@@ -163,6 +200,23 @@ def load_baseline(path: Path) -> set:
             if "fingerprint" in f}
 
 
+def prune_baseline(path: Path, used: set) -> int:
+    """Rewrite the baseline file keeping only entries whose fingerprint
+    still suppresses a live finding; returns how many were dropped."""
+    try:
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError):
+        return 0
+    entries = data.get("findings", [])
+    live = [e for e in entries if e.get("fingerprint") in used]
+    dropped = len(entries) - len(live)
+    if dropped:
+        Path(path).write_text(
+            json.dumps({"findings": live}, indent=2) + "\n",
+            encoding="utf-8")
+    return dropped
+
+
 def write_baseline(path: Path, findings: list) -> None:
     data = {"findings": [{"fingerprint": f.fingerprint(),
                           "rule": f.rule, "path": f.path, "line": f.line}
@@ -172,12 +226,17 @@ def write_baseline(path: Path, findings: list) -> None:
 
 
 def run_lint(root: Path, baseline: set | None = None,
-             native_dir: Path | None = None) -> list:
+             native_dir: Path | None = None,
+             used_baseline: set | None = None) -> list:
     """Lint the package at ``root``; returns surviving findings sorted by
     (path, line). ``native_dir`` defaults to ``root``/native when present
-    (set it explicitly to cross-check an out-of-tree fixture)."""
+    (set it explicitly to cross-check an out-of-tree fixture).
+    ``used_baseline``, when given, collects the baseline fingerprints
+    that actually matched a finding — the CLI diffs it against the full
+    baseline to report (and ``--prune-baseline`` to drop) stale
+    entries."""
     from . import abi, rules_async, rules_donation, rules_hygiene, \
-        rules_jax
+        rules_jax, rules_locks
 
     project = load_project(Path(root))
     findings: list = []
@@ -191,6 +250,7 @@ def run_lint(root: Path, baseline: set | None = None,
     findings += rules_hygiene.run(project)
     findings += rules_async.run(project)
     findings += rules_donation.run(project)
+    findings += rules_locks.run(project)
     if native_dir is None:
         candidate = Path(root) / "native"
         native_dir = candidate if candidate.is_dir() else None
@@ -201,17 +261,29 @@ def run_lint(root: Path, baseline: set | None = None,
     by_relpath = {mod.relpath: mod for mod in project.modules}
     suppressions = {relpath: _suppressions(mod)
                     for relpath, mod in by_relpath.items()}
-    kept = []
+    survivors = []
+    used_supp: set = set()
     for f in findings:
         mod = by_relpath.get(f.path)
         if mod is not None:
             per_line, file_wide = suppressions[f.path]
-            if _suppressed(f, per_line, file_wide):
+            hit = _suppression_hit(f, per_line, file_wide)
+            if hit is not None:
+                used_supp.add((f.path,) + hit)
                 continue
             if not f.source_line:
                 f = Finding(f.rule, f.path, f.line, f.message, f.severity,
                             mod.source_line(f.line))
+        survivors.append(f)
+    # Stale-suppression hygiene runs before the baseline filter so a
+    # --write-baseline round trip covers these findings too.
+    survivors += _stale_suppression_findings(by_relpath, suppressions,
+                                             used_supp)
+    kept = []
+    for f in survivors:
         if baseline and f.fingerprint() in baseline:
+            if used_baseline is not None:
+                used_baseline.add(f.fingerprint())
             continue
         kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.rule))

@@ -15,13 +15,36 @@ Tests assert stability with :func:`expect_max_retraces`::
 
 Works on every JAX version (it relies on nothing but trace-time
 execution of the wrapped Python body).
+
+Thread safety: traces happen on whatever thread first calls a cold
+program — under the scheduler that is the device thread, the Tier-1
+pool *and* request threads all at once, and ``Counter.__iadd__`` is a
+read-modify-write. Every bump and snapshot goes through ``_LOCK``; a
+lost increment here would mean a production retrace (a multi-second
+compile stall) that no test and no dashboard ever sees.
+
+Production visibility: :func:`set_metrics_sink` (installed by the API
+server alongside the encoder/decoder sinks) mirrors each trace into a
+``retrace.<stage>`` counter on ``/metrics``, so steady-state services
+can alert on the thing the test-time sentinel only catches in CI.
 """
 from __future__ import annotations
 
 import contextlib
+import threading
 from collections import Counter
 
 TRACE_COUNTS: Counter = Counter()
+_LOCK = threading.Lock()
+_SINK = None
+
+
+def set_metrics_sink(sink) -> None:
+    """Install a server.metrics.Metrics-like sink (``count``); each XLA
+    trace then also bumps the ``retrace.<stage>`` counter there. None
+    disables."""
+    global _SINK
+    _SINK = sink
 
 
 def instrument(stage: str, fn):
@@ -32,20 +55,25 @@ def instrument(stage: str, fn):
     number of traced program variants.
     """
     def traced(*args, **kwargs):
-        TRACE_COUNTS[stage] += 1
+        with _LOCK:
+            TRACE_COUNTS[stage] += 1
+        sink = _SINK
+        if sink is not None:
+            sink.count(f"retrace.{stage}")
         return fn(*args, **kwargs)
     traced.__name__ = getattr(fn, "__name__", stage)
     return traced
 
 
 def snapshot() -> dict:
-    return dict(TRACE_COUNTS)
+    with _LOCK:
+        return dict(TRACE_COUNTS)
 
 
 def delta(before: dict, stages=None) -> dict:
     """New traces per stage since ``before`` (only nonzero entries)."""
     out = {}
-    for stage, count in TRACE_COUNTS.items():
+    for stage, count in snapshot().items():
         if stages is not None and stage not in stages:
             continue
         d = count - before.get(stage, 0)

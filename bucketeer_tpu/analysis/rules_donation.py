@@ -1,15 +1,27 @@
-"""missing-donation: jitted entry points that never donate their inputs.
+"""missing-donation: jitted entry points with no donation decision.
 
 The codec's jitted programs consume large freshly-staged host arrays —
 a tile batch, a half-magnitude coefficient batch — that no caller reads
 after the launch. Without ``donate_argnums`` XLA must keep the input
 buffer alive alongside the output, doubling (or worse) the HBM
 high-water mark of every launch; with it the input aliases into the
-output. Donation is free to request and silently ignored only where
-unsupported (the CPU backend warns — the codec gates it through
-``pipeline.donate_argnums_if_supported``), so a jit call in the hot
-modules with *no* donation spec is either an oversight or needs an
-explicit whitelist entry explaining why aliasing would be wrong.
+output. A jit call in the hot modules with *no* donation spec is either
+an oversight or needs an explicit whitelist entry explaining why
+aliasing would be wrong.
+
+This AST rule enforces that a *decision* is on record: every jit call
+in scope must either pass ``donate_argnums``/``donate_argnames`` (the
+``*_program`` seams do, with an explicit — possibly empty — spec and
+the reason in their docstring) or be whitelisted here. Whether a
+recorded donation actually *takes effect* is the compiled-artifact
+audit's job (analysis/deviceaudit.py): it lowers each program with
+donation forced and checks the ``tf.aliasing_output`` attribute, which
+is how the front-end and decode-inverse donations PR 6 requested were
+discovered to be silently dropped — no output aval matches the donated
+input (the color axis moves between input and output), so XLA cannot
+alias. Those specs are now explicitly empty at the seams, with the
+audit guarding both directions (a declared donation that stops
+aliasing, and an "unusable" claim that becomes aliasable).
 
 Scope: the device entry points of the encode front-end
 (``codec/frontend.py``) and the decode back half
@@ -23,7 +35,7 @@ from __future__ import annotations
 import ast
 
 from .findings import ERROR, Finding
-from .rules_jax import _attr_root, _unwrap_jit_target
+from .rules_jax import _attr_root, _unwrap_jit_target, enclosing_functions
 
 MISSING_DONATION = "missing-donation"
 
@@ -45,6 +57,7 @@ def run(project) -> list:
     for mod in project.modules:
         if not mod.relpath.endswith(SCOPES):
             continue
+        scopes = enclosing_functions(mod)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
@@ -55,18 +68,20 @@ def run(project) -> list:
                       or root in mod.jit_names)
             if not is_jit:
                 continue
-            name, _ = _unwrap_jit_target(mod, node.args[0])
+            name, _ = _unwrap_jit_target(mod, node.args[0], project,
+                                         scopes.get(id(node)))
             if name in WHITELIST:
                 continue
             if any(kw.arg in DONATE_KWARGS for kw in node.keywords):
                 continue
             findings.append(Finding(
                 MISSING_DONATION, mod.relpath, node.lineno,
-                f"jit of {name or '<anonymous>'} donates none of its "
-                "array arguments: the staged input buffer stays live "
-                "beside the output for the whole launch. Pass "
-                "donate_argnums (pipeline.donate_argnums_if_supported "
-                "gates CPU), or whitelist the function in "
-                "rules_donation with the reason aliasing is unsafe",
+                f"jit of {name or '<anonymous>'} records no donation "
+                "decision: the staged input buffer stays live beside "
+                "the output for the whole launch. Pass donate_argnums "
+                "(pipeline.donate_argnums_if_supported gates CPU; an "
+                "explicit empty spec with the reason documented also "
+                "counts), or whitelist the function in rules_donation "
+                "with the reason aliasing is unsafe",
                 ERROR, mod.source_line(node.lineno)))
     return findings

@@ -374,28 +374,114 @@ class _FnAnalysis:
                 self.taint(child)
 
 
-def _unwrap_jit_target(mod, node):
+def _local_binding(scope, name: str):
+    """The last value expression bound to ``name`` inside ``scope`` (a
+    FunctionDef body), plus the tuple index when the binding is an
+    unpacking assignment (``fn, donate = ...``). (None, None) when the
+    name is not locally bound."""
+    found = (None, None)
+    if scope is None:
+        return found
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                found = (node.value, None)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for i, e in enumerate(t.elts):
+                    if isinstance(e, ast.Name) and e.id == name:
+                        found = (node.value, i)
+    return found
+
+
+def _seam_return(project, mod, call: ast.Call, index: int):
+    """Resolve ``x, y = some_program(...)`` through the callee: find
+    the seam function's ``return fn, donate`` and hand back
+    (element expression, its module, its scope). The ``*_program``
+    seams each jitted codec module exports (the construction both the
+    production jit and the device audit consume) bind their traceable
+    callable this way."""
+    callee, _ = _attr_root(call.func)
+    leaf = callee
+    if isinstance(call.func, ast.Attribute):
+        leaf = call.func.attr
+    cmod, cnode = _resolve(project, mod, leaf)
+    if cnode is None:
+        return None, None, None
+    for node in ast.walk(cnode):
+        if isinstance(node, ast.Return) and \
+                isinstance(node.value, (ast.Tuple, ast.List)) and \
+                index < len(node.value.elts):
+            return node.value.elts[index], cmod, cnode
+    return None, None, None
+
+
+def _unwrap_jit_target(mod, node, project=None, scope=None, depth=0):
     """Resolve a jit/shard_map first argument to (func name, n_static).
 
-    Handles ``fn``, ``partial(fn, a, b)`` (leading args static) and the
-    retrace wrapper ``instrument("stage", fn_or_partial)``.
+    Handles ``fn``, ``partial(fn, a, b)`` (leading args static), the
+    retrace wrapper ``instrument("stage", fn_or_partial)``, and — when
+    ``project``/``scope`` are given — local bindings through the
+    ``*_program`` seams: ``fn, donate = frontend_program(...)`` then
+    ``jax.jit(fn, ...)`` resolves through the seam's return statement
+    to the underlying traced body.
     """
+    if depth > 6:
+        return None, 0
     if isinstance(node, ast.Name):
+        if project is not None:
+            value, idx = _local_binding(scope, node.id)
+            if value is not None:
+                if idx is not None:
+                    if isinstance(value, (ast.Tuple, ast.List)) and \
+                            idx < len(value.elts):
+                        return _unwrap_jit_target(
+                            mod, value.elts[idx], project, scope,
+                            depth + 1)
+                    if isinstance(value, ast.Call):
+                        elt, emod, escope = _seam_return(
+                            project, mod, value, idx)
+                        if elt is not None:
+                            return _unwrap_jit_target(
+                                emod, elt, project, escope, depth + 1)
+                    return node.id, 0
+                return _unwrap_jit_target(mod, value, project, scope,
+                                          depth + 1)
         return node.id, 0
     if isinstance(node, ast.Call):
         root, chain = _attr_root(node.func)
         leaf = chain[-1] if chain else root
         if leaf == "instrument" and node.args:
-            return _unwrap_jit_target(mod, node.args[-1])
+            return _unwrap_jit_target(mod, node.args[-1], project,
+                                      scope, depth + 1)
         if root in mod.partial_aliases or leaf == "partial":
             if node.args and isinstance(node.args[0], ast.Name):
                 return node.args[0].id, len(node.args) - 1
     return None, 0
 
 
-def _find_jit_roots(mod):
+def enclosing_functions(mod) -> dict:
+    """id(node) -> the innermost FunctionDef containing it."""
+    out: dict = {}
+
+    def visit(fnode, current):
+        for child in ast.iter_child_nodes(fnode):
+            inner = (child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else current)
+            if current is not None:
+                out[id(child)] = current
+            visit(child, inner)
+
+    visit(mod.tree, None)
+    return out
+
+
+def _find_jit_roots(mod, project=None):
     """[(target function name, set of static param positions)]."""
     roots = []
+    scopes = enclosing_functions(mod) if project is not None else {}
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -407,7 +493,8 @@ def _find_jit_roots(mod):
                   or leaf == "shard_map" and root in mod.shardmap_names)
         if not is_jit or not node.args:
             continue
-        name, n_static = _unwrap_jit_target(mod, node.args[0])
+        name, n_static = _unwrap_jit_target(mod, node.args[0], project,
+                                            scopes.get(id(node)))
         if name is None:
             continue
         static = set(range(n_static))
@@ -452,7 +539,7 @@ def _device_region(project):
                 worklist.append(fn)
 
     for mod in project.modules:
-        for name, static in _find_jit_roots(mod):
+        for name, static in _find_jit_roots(mod, project):
             rmod, rnode = _resolve(project, mod, name)
             if rnode is None:
                 continue

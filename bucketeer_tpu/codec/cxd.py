@@ -49,6 +49,7 @@ from jax import lax
 from ..analysis import retrace
 from ..config import truthy as cfg_truthy
 from .mq import CTX_RL, CTX_UNIFORM, MQEncoder
+from .pipeline import donate_argnums_if_supported
 from .t1 import _SC, _ZC_HH, _ZC_LL_LH, BAND_CLS
 
 CBLK = 64
@@ -337,19 +338,33 @@ def _cxd_body(impl, blocks, nbps, floors, cls, hs, ws):
     return packed, counts, dh, dl, cur
 
 
+def cxd_program(P: int, frac_bits: int, pallas: bool | None = None,
+                interpret: bool = False):
+    """(traceable fn, device donate_argnums) for one CX/D program —
+    the construction :func:`_compiled_cxd` jits, shared with the device
+    audit (analysis/deviceaudit.py), which lowers both implementations
+    on CPU (the Pallas kernel in interpret mode). ``pallas=None``
+    defers to the runtime choice (:func:`_use_pallas`). The donate spec
+    is empty by verified fact: no output aval matches the (N, 64, 64)
+    int32 block input (symbol rows are uint8, tables are per-pass), so
+    XLA would drop the alias silently."""
+    if _use_pallas() if pallas is None else pallas:
+        from .pallas.cxd_scan import cxd_pallas
+        impl = partial(cxd_pallas, P, frac_bits, interpret=interpret)
+    else:
+        impl = jax.vmap(partial(_cxd_single, P, frac_bits,
+                                jnp.asarray(scan_xs(P))))
+    return retrace.instrument("cxd", partial(_cxd_body, impl)), ()
+
+
 @lru_cache(maxsize=64)
 def _compiled_cxd(P: int, frac_bits: int):
     """One jitted CX/D program per (plane count, fixed-point shift).
     The Pallas-vs-jnp choice is made here, outside the traced body
     (cached with the program — flip BUCKETEER_CXD_PALLAS before first
     use)."""
-    if _use_pallas():
-        from .pallas.cxd_scan import cxd_pallas
-        impl = partial(cxd_pallas, P, frac_bits)
-    else:
-        impl = jax.vmap(partial(_cxd_single, P, frac_bits,
-                                jnp.asarray(scan_xs(P))))
-    return jax.jit(retrace.instrument("cxd", partial(_cxd_body, impl)))
+    fn, donate = cxd_program(P, frac_bits)
+    return jax.jit(fn, donate_argnums=donate_argnums_if_supported(*donate))
 
 
 # --- host-side result assembly ------------------------------------------

@@ -102,13 +102,25 @@ def _inverse_body(plan: InversePlan, half_map, hv: jnp.ndarray):
     return x.astype(jnp.int32)
 
 
-@lru_cache(maxsize=256)
-def _compiled_inverse(plan: InversePlan):
+def inverse_program(plan: InversePlan):
+    """(traceable fn, device donate_argnums) — the construction
+    :func:`_compiled_inverse` jits, shared with the device audit
+    (analysis/deviceaudit.py). The donate spec is empty by verified
+    fact: the (B, C, h, w) int32 half-magnitude input never matches the
+    (B, h, w, C) sample output aval (the color axis moves), so XLA
+    silently drops any requested alias — the audit's forced lowering
+    proves ``tf.aliasing_output`` never appears. The whitelist entry in
+    ``rules_donation`` records the same reason."""
     half_map = (None if plan.reversible
                 else jnp.asarray(_half_step_map(plan)))
-    return jax.jit(retrace.instrument(
-        "inverse", partial(_inverse_body, plan, half_map)),
-        donate_argnums=donate_argnums_if_supported(0))
+    return retrace.instrument(
+        "inverse", partial(_inverse_body, plan, half_map)), ()
+
+
+@lru_cache(maxsize=256)
+def _compiled_inverse(plan: InversePlan):
+    fn, donate = inverse_program(plan)
+    return jax.jit(fn, donate_argnums=donate_argnums_if_supported(*donate))
 
 
 # --- windowed (region) inverse -------------------------------------------
@@ -259,13 +271,22 @@ def _compiled_region_inverse(plan: RegionPlan):
         plan.levels, plan.steps, plan.used_mct, plan.bitdepth)
 
 
+def region_program(levels: int, steps: tuple, used_mct: bool,
+                   bitdepth: int):
+    """(traceable fn, device donate_argnums) for the windowed reversible
+    synthesis — audit seam. Donation of the per-slot window tuple is
+    unusable (no slot aval matches the (h, w, C) sample output); the
+    audit verifies the drop, ``rules_donation`` records it."""
+    return retrace.instrument(
+        "region_inverse",
+        partial(_region_body, levels, steps, used_mct, bitdepth)), ()
+
+
 @lru_cache(maxsize=256)
 def _compiled_region_inverse_cached(levels: int, steps: tuple,
                                     used_mct: bool, bitdepth: int):
-    return jax.jit(retrace.instrument(
-        "region_inverse",
-        partial(_region_body, levels, steps, used_mct, bitdepth)),
-        donate_argnums=donate_argnums_if_supported(0))
+    fn, donate = region_program(levels, steps, used_mct, bitdepth)
+    return jax.jit(fn, donate_argnums=donate_argnums_if_supported(*donate))
 
 
 def _full_plan_from_region(plan: RegionPlan) -> InversePlan:

@@ -175,16 +175,31 @@ def _frontend_body(plan: TilePlan, P: int, frac_bits: int, mode: str,
     return blocks, stats
 
 
-@lru_cache(maxsize=256)
-def _compiled_frontend(plan: TilePlan, P: int, mode: str = "rows"):
+def frontend_program(plan: TilePlan, P: int, mode: str = "rows"):
+    """(traceable fn, device donate_argnums) for one front-end variant —
+    the exact construction :func:`_compiled_frontend` jits, shared with
+    the device audit (analysis/deviceaudit.py) so the audited artifact
+    is the shipped one.
+
+    The donate spec is empty by *verified fact*, not oversight: the
+    staged (B, h, w, C) int32 tile batch matches no output aval (rows
+    are uint8 bitmaps, stats are per-block vectors), so XLA silently
+    drops the alias — the audit lowers this program with donation
+    forced and proves the ``tf.aliasing_output`` attribute never
+    appears. Requesting it anyway would only emit a per-compile
+    warning; ``rules_donation.WHITELIST`` records the same fact."""
     frac_bits = 0 if plan.lossless else FRAC_BITS
     step_map = jnp.asarray(_step_map(plan)) if not plan.lossless else None
-    # The tile batch is staged fresh per dispatch and never read again
-    # on host after the launch; donating it caps HBM at one copy.
-    return jax.jit(retrace.instrument(
+    fn = retrace.instrument(
         "frontend", partial(_frontend_body, plan, P, frac_bits, mode,
-                            step_map)),
-        donate_argnums=donate_argnums_if_supported(0))
+                            step_map))
+    return fn, ()
+
+
+@lru_cache(maxsize=256)
+def _compiled_frontend(plan: TilePlan, P: int, mode: str = "rows"):
+    fn, donate = frontend_program(plan, P, mode)
+    return jax.jit(fn, donate_argnums=donate_argnums_if_supported(*donate))
 
 
 @dataclass
@@ -320,11 +335,19 @@ def run_frontend(plan: TilePlan, tiles: np.ndarray) -> FrontendResult:
     return dispatch_frontend(plan, tiles).resolve_stats()
 
 
-@lru_cache(maxsize=8)
-def _compiled_gather(chunk_rows: int):
+def gather_program():
+    """(traceable fn, donate spec) for the compaction gather — audit
+    seam. ``rows`` is deliberately non-donated (whitelisted): one
+    payload fetch re-reads the same device buffer across chunks."""
     def gather(rows, src):
         return rows[src]
-    return jax.jit(retrace.instrument("gather", gather))
+    return retrace.instrument("gather", gather), ()
+
+
+@lru_cache(maxsize=8)
+def _compiled_gather(chunk_rows: int):
+    fn, donate = gather_program()
+    return jax.jit(fn, donate_argnums=donate_argnums_if_supported(*donate))
 
 
 GATHER_CHUNK = 4096      # rows per gather dispatch (= 2 MB of payload)

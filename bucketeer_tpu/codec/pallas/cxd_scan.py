@@ -20,7 +20,12 @@ Status: semantics are locked to the jnp path by interpret-mode parity
 tests (tests/test_cxd.py) on every CI run; the compiled-on-real-TPU
 path is selected by ``BUCKETEER_CXD_PALLAS`` (default: auto — TPU
 backend only) and can be disabled with ``BUCKETEER_CXD_PALLAS=0`` if a
-Mosaic version rejects the scalar-indexed updates.
+Mosaic version rejects the scalar-indexed updates. The device audit
+(analysis/deviceaudit.py, CI ``audit`` job) also lowers the
+interpret-mode program on CPU every PR — via ``cxd.cxd_program(...,
+pallas=True, interpret=True)`` — so structural drift in the kernel's
+emitted ops (and any host callback or f64 creeping in) fails a PR even
+without TPU hardware in the loop.
 """
 from __future__ import annotations
 

@@ -169,14 +169,26 @@ def _transform_batch(plan: TilePlan, step_map: jnp.ndarray,
     return quantize_fp(coeffs, step_map)
 
 
+def transform_program(plan: TilePlan):
+    """(traceable fn, device donate_argnums) for the standalone sample
+    transform — the construction :func:`compiled_transform` jits,
+    shared with the device audit (analysis/deviceaudit.py). Donation of
+    the sample batch is unusable here: the (B, h, w, C) input aval
+    never matches the (B, C, h, w) coefficient output (axis order), so
+    XLA would silently drop the alias — verified by the audit's forced
+    lowering."""
+    step_map = jnp.asarray(_step_map(plan)) if not plan.lossless else None
+    return retrace.instrument(
+        "transform", partial(_transform_batch, plan, step_map)), ()
+
+
 @lru_cache(maxsize=256)
 def compiled_transform(plan: TilePlan):
     """The jitted device computation for one plan. XLA still specializes
     on the batch size; callers bound retraces by padding B to a bucket
     size (:func:`run_tiles`)."""
-    step_map = jnp.asarray(_step_map(plan)) if not plan.lossless else None
-    return jax.jit(retrace.instrument(
-        "transform", partial(_transform_batch, plan, step_map)))
+    fn, donate = transform_program(plan)
+    return jax.jit(fn, donate_argnums=donate_argnums_if_supported(*donate))
 
 
 def donate_argnums_if_supported(*argnums) -> tuple:

@@ -420,9 +420,12 @@ class EncodeScheduler:
                     daemon=True)
                 self._device_thread.start()
 
-    def _take_compatible(self, group: list) -> int:
+    def _take_compatible_locked(self, group: list) -> int:
         """Move queued jobs merge-compatible with group[0] into the
-        group (caller holds the queue cv). Returns group tile total."""
+        group (the _locked suffix is the codebase convention for
+        "caller holds the lock" — here the queue cv; the lock-discipline
+        lint, analysis/rules_locks.py, keys on it). Returns the group
+        tile total."""
         key = group[0].key
         total = sum(j.n_tiles for j in group)
         kept: deque = deque()
@@ -455,7 +458,7 @@ class EncodeScheduler:
                     # could still contribute one.
                     limit = time.monotonic() + self.window_s
                     while True:
-                        total = self._take_compatible(group)
+                        total = self._take_compatible_locked(group)
                         if (len(group) >= max(1, self._running)
                                 or total >= _MAX_BATCH_TILES):
                             break
@@ -474,7 +477,7 @@ class EncodeScheduler:
                         self._dq_cv.wait(remaining)
                 elif group[0].mode == "rows":
                     # No window: merge only what is already queued.
-                    self._take_compatible(group)
+                    self._take_compatible_locked(group)
             try:
                 self._launch(group)
             except Exception:

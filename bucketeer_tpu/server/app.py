@@ -84,6 +84,13 @@ class Api:
         # per-launch batch occupancy and admission rejects into the
         # same registry, so /metrics shows the serving picture whole.
         get_scheduler().set_metrics_sink(self.metrics)
+        # XLA retrace sentinel: every compile of a jitted stage bumps a
+        # retrace.<stage> counter here. In production a retrace is a
+        # multi-second device stall (usually an unstable shape leaking
+        # past the pow-2 buckets) — this makes it alertable, not just a
+        # test-time assertion.
+        from ..analysis import retrace
+        retrace.set_metrics_sink(self.metrics)
         # Decode work is admitted through the same scheduler as encodes
         # (typed read-priority jobs): tile reads share the bounded
         # queue's 503 backpressure but outrank queued encodes, and the

@@ -307,6 +307,110 @@ def test_file_level_suppression(tmp_path):
     assert lint.run_lint(root) == []
 
 
+def test_stale_inline_suppression_is_a_warning(tmp_path):
+    """A disable comment that suppresses nothing is itself reported
+    (warning severity: fails --strict, tolerated otherwise)."""
+    root = _make_pkg(tmp_path, {"engine/ok.py": """\
+        def f(g):
+            return g()   # graftlint: disable=swallowed-exception
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["stale-suppression"]
+    assert findings[0].severity == "warning"
+    assert findings[0].line == 2
+    assert "swallowed-exception" in findings[0].message
+
+
+def test_stale_file_suppression_is_a_warning(tmp_path):
+    root = _make_pkg(tmp_path, {"engine/ok.py": """\
+        # graftlint: disable-file=host-sync
+        def f(g):
+            return g()
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["stale-suppression"]
+    assert "disable-file=host-sync" in findings[0].message
+
+
+def test_live_suppression_is_not_stale(tmp_path):
+    root = _make_pkg(tmp_path, {"engine/sup.py": """\
+        def f(g):
+            try:
+                return g()
+            except Exception:   # graftlint: disable=swallowed-exception
+                pass
+        """})
+    assert lint.run_lint(root) == []
+
+
+def test_partially_stale_suppression_flags_only_dead_rules(tmp_path):
+    """disable=a,b where only a fires: b is the stale half."""
+    root = _make_pkg(tmp_path, {"engine/sup.py": """\
+        def f(g):
+            try:
+                return g()
+            except Exception:   # graftlint: disable=swallowed-exception,host-sync
+                pass
+        """})
+    findings = lint.run_lint(root)
+    assert _rules(findings) == ["stale-suppression"]
+    assert "host-sync" in findings[0].message
+
+
+def test_cli_strict_fails_on_stale_suppression_and_baseline(tmp_path,
+                                                            capsys):
+    root = _make_pkg(tmp_path, {"engine/ok.py": """\
+        def f(g):
+            return g()   # graftlint: disable=swallowed-exception
+        """})
+    assert cli_main([str(root)]) == 0                 # warning only
+    assert cli_main([str(root), "--strict"]) == 1
+    capsys.readouterr()
+
+    # A baseline entry that matches nothing is likewise a warning...
+    baseline = tmp_path / "b.json"
+    baseline.write_text(json.dumps({"findings": [
+        {"fingerprint": "deadbeefdeadbeef", "rule": "host-sync",
+         "path": "x.py", "line": 1}]}), encoding="utf-8")
+    clean = _make_pkg(tmp_path / "c", {"engine/ok.py": "X = 1\n"})
+    assert cli_main([str(clean), "--baseline", str(baseline)]) == 0
+    assert cli_main([str(clean), "--strict",
+                     "--baseline", str(baseline)]) == 1
+    out = capsys.readouterr().out
+    assert "stale-baseline-entry" in out
+    assert "deadbeefdeadbeef" in out
+
+
+def test_prune_baseline_rewrites_only_stale_entries(tmp_path, capsys):
+    root = _make_pkg(tmp_path, {"engine/bad.py": """\
+        def f(g):
+            try:
+                return g()
+            except Exception:
+                pass
+        """})
+    baseline = tmp_path / "b.json"
+    assert cli_main([str(root), "--write-baseline",
+                     "--baseline", str(baseline)]) == 0
+    # Seed one dead fingerprint beside the live one.
+    data = json.loads(baseline.read_text(encoding="utf-8"))
+    data["findings"].append({"fingerprint": "feedfacefeedface",
+                             "rule": "host-sync", "path": "x.py",
+                             "line": 1})
+    baseline.write_text(json.dumps(data), encoding="utf-8")
+    capsys.readouterr()
+    assert cli_main([str(root), "--strict", "--prune-baseline",
+                     "--baseline", str(baseline)]) == 0
+    out = capsys.readouterr().out
+    assert "pruned 1 stale entry" in out
+    kept = json.loads(baseline.read_text(encoding="utf-8"))["findings"]
+    assert len(kept) == 1
+    assert kept[0]["fingerprint"] != "feedfacefeedface"
+    # The pruned baseline still suppresses the live finding.
+    assert cli_main([str(root), "--strict",
+                     "--baseline", str(baseline)]) == 0
+
+
 def test_baseline_filters_known_findings(tmp_path):
     root = _make_pkg(tmp_path, {"engine/bad.py": """\
         def f(g):

@@ -1,0 +1,280 @@
+"""Compiled-artifact audit (analysis/deviceaudit.py): the registry
+lowers on CPU, donation facts are verified at the HLO level, host
+round-trips and f64 are detected, manifest drift fails, and the d2h
+whitelist is validated against the code.
+
+The expensive part — lowering every registered program — runs once per
+module (session-scoped fixture); the failure-mode tests lower tiny
+synthetic programs instead.
+"""
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from bucketeer_tpu.analysis import deviceaudit, lint
+from bucketeer_tpu.analysis.__main__ import main as cli_main
+
+REPO = Path(__file__).resolve().parent.parent
+MANIFEST = REPO / ".graftaudit-manifest.json"
+
+
+@pytest.fixture(scope="session")
+def repo_facts():
+    return deviceaudit.run_programs()
+
+
+def _lowered(repo_facts):
+    return [f for f in repo_facts if not f.skipped]
+
+
+# --- the registry on the real codec -----------------------------------
+
+def test_registry_lowers_at_least_three_entry_points(repo_facts):
+    lowered = _lowered(repo_facts)
+    assert len(lowered) >= 3, [f.skipped for f in repo_facts]
+    families = {f.name.split("/")[0] for f in lowered}
+    # All three jitted codec layers are represented.
+    assert {"frontend.rows", "cxd.scan", "decode.inverse"} <= families
+
+
+def test_repo_programs_are_clean(repo_facts):
+    findings = []
+    for facts in repo_facts:
+        findings += deviceaudit.check_program(facts)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_no_host_roundtrips_inside_device_programs(repo_facts):
+    for facts in _lowered(repo_facts):
+        assert facts.transfers == (), facts.name
+        assert not facts.f64, facts.name
+
+
+def test_donation_facts_match_declared_specs(repo_facts):
+    """Every seam currently records donation as unusable (verified: the
+    probe forces donation and XLA aliases nothing) — so the lowered
+    alias set must equal the declared set for every program. A future
+    program with a matching output aval flips this by declaring the
+    donation, and the audit then enforces it stays effective."""
+    for facts in _lowered(repo_facts):
+        assert set(facts.aliased) == set(facts.declared_donate), facts.name
+
+
+def test_checked_in_manifest_matches_lowered_programs(repo_facts):
+    manifest = deviceaudit.manifest_from_facts(repo_facts)
+    drift = deviceaudit.diff_manifest(
+        deviceaudit.load_manifest(MANIFEST), manifest)
+    assert drift == [], ("compiled programs drifted; regenerate with "
+                         "`python -m bucketeer_tpu.analysis "
+                         "--write-manifest` and commit the diff:\n"
+                         + "\n".join(drift))
+
+
+# --- failure modes, demonstrated on synthetic programs -----------------
+
+def _synthetic(fn, declared, avals, probe=(0,), reason="unusable"):
+    entry = deviceaudit.AuditProgram(
+        "synthetic/test", lambda: (fn, declared, avals),
+        probe_donate=probe, donate_reason=reason)
+    facts = deviceaudit.lower_program(entry)
+    facts.donate_reason = reason
+    assert not facts.skipped, facts.skipped
+    return facts
+
+
+def test_effective_donation_is_verified():
+    import jax
+    import jax.numpy as jnp
+
+    facts = _synthetic(lambda x: x * 2, (0,),
+                       [jax.ShapeDtypeStruct((8, 8), jnp.float32)])
+    assert facts.aliased == (0,)
+    assert deviceaudit.check_program(facts) == []
+
+
+def test_dropped_donation_is_detected():
+    """The silent-drop case: the donated arg's aval matches no output
+    (dtype changes), XLA keeps the donation request but aliases
+    nothing — the audit must fail it."""
+    import jax
+    import jax.numpy as jnp
+
+    facts = _synthetic(lambda x: x.astype(jnp.int32) + 1, (0,),
+                       [jax.ShapeDtypeStruct((8, 8), jnp.float32)])
+    assert facts.aliased == ()
+    rules = [f.rule for f in deviceaudit.check_program(facts)]
+    assert rules == [deviceaudit.DONATION_DROPPED]
+
+
+def test_stale_unusable_claim_is_detected():
+    """A program recorded donation-unusable whose probe *does* alias:
+    the claim is stale and the HBM saving is being left on the table."""
+    import jax
+    import jax.numpy as jnp
+
+    facts = _synthetic(lambda x: x * 2, (),
+                       [jax.ShapeDtypeStruct((8, 8), jnp.float32)])
+    assert facts.aliased == (0,)
+    findings = deviceaudit.check_program(facts)
+    assert [f.rule for f in findings] == [deviceaudit.STALE_DONATION]
+    assert findings[0].severity == "warning"
+
+
+def test_lifetime_buffers_are_never_stale():
+    import jax
+    import jax.numpy as jnp
+
+    facts = _synthetic(lambda x: x * 2, (),
+                       [jax.ShapeDtypeStruct((8, 8), jnp.float32)],
+                       reason="lifetime")
+    assert deviceaudit.check_program(facts) == []
+
+
+def test_host_callback_is_detected():
+    import jax
+    import jax.numpy as jnp
+
+    def leaky(x):
+        jax.debug.callback(lambda v: None, x)
+        return x + 1
+
+    facts = _synthetic(leaky, (), [jax.ShapeDtypeStruct((4,), jnp.float32)],
+                       probe=())
+    assert facts.transfers, "callback custom_call not surfaced"
+    rules = [f.rule for f in deviceaudit.check_program(facts)]
+    assert deviceaudit.HOST_TRANSFER in rules
+
+
+def test_f64_is_detected():
+    import jax
+    import jax.numpy as jnp
+
+    def promoting(x):
+        return x.astype(jnp.float64) * 2
+
+    with jax.experimental.enable_x64():
+        facts = _synthetic(promoting, (),
+                           [jax.ShapeDtypeStruct((4,), jnp.float32)],
+                           probe=())
+    assert facts.f64
+    rules = [f.rule for f in deviceaudit.check_program(facts)]
+    assert deviceaudit.F64_IN_PROGRAM in rules
+
+
+def test_f64_regex_ignores_hex_constant_payloads():
+    facts = deviceaudit.ProgramFacts("x")
+    assert deviceaudit._F64_RE.search("tensor<4x4xf64>")
+    assert deviceaudit._F64_RE.search("tensor<f64>")
+    assert not deviceaudit._F64_RE.search('dense<"0x3f64ab..."> : '
+                                          "tensor<4xf32>")
+    assert not facts.f64
+
+
+# --- manifest drift ----------------------------------------------------
+
+def test_manifest_drift_is_detected(repo_facts, tmp_path):
+    manifest = deviceaudit.manifest_from_facts(repo_facts)
+    tampered = json.loads(json.dumps(manifest))
+    name = sorted(tampered["programs"])[0]
+    tampered["programs"][name]["fingerprint"] = "0" * 64
+    tampered["programs"][name]["op_counts"]["stablehlo.convert"] = 999
+    drift = deviceaudit.diff_manifest(tampered, manifest)
+    assert len(drift) == 1 and name in drift[0]
+    assert "stablehlo.convert" in drift[0]
+
+    tampered["programs"]["ghost/program"] = {"fingerprint": "x",
+                                             "op_counts": {}}
+    drift = deviceaudit.diff_manifest(tampered, manifest)
+    assert any("ghost/program" in line for line in drift)
+
+    assert deviceaudit.diff_manifest(None, manifest) != []
+
+
+def test_env_skipped_programs_are_not_drift(repo_facts):
+    """A program the manifest records but this environment cannot
+    lower (facts.skipped) must not read as a removed registry entry —
+    the skip mechanism exists to tolerate exactly that."""
+    manifest = deviceaudit.manifest_from_facts(repo_facts)
+    reduced = json.loads(json.dumps(manifest))
+    name = sorted(reduced["programs"])[0]
+    del reduced["programs"][name]
+    assert any(name in line for line in
+               deviceaudit.diff_manifest(manifest, reduced))
+    assert deviceaudit.diff_manifest(manifest, reduced,
+                                     skipped=(name,)) == []
+
+
+def test_jax_version_change_is_one_actionable_line(repo_facts):
+    """A jax upgrade shifts every fingerprint; the diff must say so in
+    one line naming both versions instead of per-program noise."""
+    manifest = deviceaudit.manifest_from_facts(repo_facts)
+    stale = json.loads(json.dumps(manifest))
+    stale["jax"] = "0.0.stale"
+    for prog in stale["programs"].values():
+        prog["fingerprint"] = "0" * 64
+    drift = deviceaudit.diff_manifest(stale, manifest)
+    assert len(drift) == 1
+    assert "0.0.stale" in drift[0] and manifest["jax"] in drift[0]
+    assert "--write-manifest" in drift[0]
+
+
+# --- d2h whitelist validation ------------------------------------------
+
+def test_repo_d2h_whitelist_is_live():
+    project = lint.load_project(REPO / "bucketeer_tpu")
+    findings = deviceaudit.validate_d2h_whitelist(project)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_stale_d2h_whitelist_entry_is_reported(tmp_path):
+    """A sanctioned function that no longer transfers anything (and one
+    that vanished entirely) must both be reported stale."""
+    root = tmp_path / "pkg"
+    (root / "codec").mkdir(parents=True)
+    (root / "__init__.py").write_text('"""fixture"""\n')
+    (root / "codec" / "__init__.py").write_text('"""fixture"""\n')
+    (root / "codec" / "xfer.py").write_text(textwrap.dedent("""\
+        import jax
+
+
+        def gather_rows(rows):
+            return rows * 2          # no device_get anymore
+
+
+        def fetch_payload(rows):
+            return jax.device_get(rows)
+        """), encoding="utf-8")
+    project = lint.load_project(root)
+    findings = deviceaudit.validate_d2h_whitelist(project)
+    stale = {f.message.split("'")[1] for f in findings}
+    assert "gather_rows" in stale
+    assert "fetch_payload" not in stale
+    # Functions with no definition at all in the fixture are also stale.
+    assert "run_cxd" in stale
+
+
+# --- CLI ----------------------------------------------------------------
+
+def test_cli_audit_passes_on_repo(capsys):
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--audit", "--strict",
+                   "--baseline", str(REPO / ".graftlint-baseline.json"),
+                   "--manifest", str(MANIFEST)])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_cli_audit_fails_on_manifest_drift(tmp_path, capsys):
+    bad = tmp_path / "manifest.json"
+    bad.write_text(json.dumps({"jax": "0", "programs": {
+        "ghost/program": {"fingerprint": "x", "op_counts": {},
+                          "n_ops": 0}}}) + "\n", encoding="utf-8")
+    dump = tmp_path / "dump"
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--audit",
+                   "--baseline", str(REPO / ".graftlint-baseline.json"),
+                   "--manifest", str(bad), "--dump-dir", str(dump)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "audit-manifest-drift" in out
+    # The lowered programs were dumped for the CI artifact upload.
+    assert list(dump.glob("*.stablehlo.txt"))

@@ -7,7 +7,8 @@ import pytest
 
 from bucketeer_tpu.codec import encoder
 from bucketeer_tpu.codec.encoder import EncodeParams
-from bucketeer_tpu.converters.reader import _DecodeCache, TpuReader
+from bucketeer_tpu.converters.reader import (_DecodeCache, _IndexCache,
+                                             TpuReader)
 from bucketeer_tpu.server.metrics import Metrics
 
 
@@ -327,3 +328,64 @@ def test_reset_caches_drops_tiles_keeps_index(tmp_path):
     counters = sink.report()["counters"]
     assert counters["decode.cache_misses"] == 2     # tile re-decoded
     assert counters["decode.index_cache_hits"] == 1  # index survived
+
+
+# --- seeded-schedule concurrency hammer (PR 6 tiered caches) -----------
+
+def test_tile_and_index_cache_hammer_keeps_invariants():
+    """The tiered caches are hit from the scheduler's read slots, the
+    aiohttp handlers and the engine's to_thread converts all at once.
+    Each worker replays a per-thread seeded schedule of put/get/len
+    ops (deterministic across runs, interleaving decided by the
+    scheduler), and the structural invariants must hold under every
+    interleaving: the byte ledger equals the surviving entries' bytes,
+    budgets are never exceeded, and no eviction is double- or
+    un-counted (per-call eviction counts sum to the total)."""
+    import threading
+
+    tile_budget = 64 * 1024
+    tiles = _DecodeCache(tile_budget)
+    index = _IndexCache(max_entries=8)
+    n_threads, n_ops = 8, 400
+    start = threading.Barrier(n_threads)
+    evicted_by_thread = [0] * n_threads
+
+    def worker(tid):
+        rng = np.random.default_rng(1000 + tid)   # seeded schedule
+        start.wait()
+        evicted = 0
+        for i in range(n_ops):
+            op = rng.integers(0, 4)
+            key = ("t", int(rng.integers(0, 32)))
+            if op == 0:
+                arr = np.zeros(int(rng.integers(1, 4096)),
+                               dtype=np.uint8)
+                evicted += tiles.put(key, arr)
+            elif op == 1:
+                got = tiles.get(key)
+                if got is not None:
+                    assert not got.flags.writeable
+            elif op == 2:
+                evicted += index.put(("i", int(rng.integers(0, 16))),
+                                     object())
+            else:
+                index.get(("i", int(rng.integers(0, 16))))
+        evicted_by_thread[tid] = evicted
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # Byte ledger is exact: what the cache thinks it holds equals the
+    # bytes of the entries actually present, and stays within budget.
+    assert tiles.nbytes == sum(a.nbytes
+                               for a in tiles._entries.values())
+    assert tiles.nbytes <= tile_budget
+    assert len(index) <= index.max_entries
+    # Per-call eviction counts (returned under the lock) sum exactly
+    # to the totals — no eviction lost or double-counted across racing
+    # misses.
+    assert sum(evicted_by_thread) == tiles.evictions + index.evictions

@@ -57,3 +57,61 @@ def test_frontend_stage_stable(rng):
     round_trip()                              # warm frontend + gather
     with retrace.expect_max_retraces(0, stages=("frontend", "gather")):
         round_trip()
+
+
+def test_trace_counts_survive_racing_bumps():
+    """Cold programs trace on whatever thread reaches them first — the
+    scheduler's device thread, Tier-1 pool workers and request threads
+    all at once. Counter.__iadd__ is a read-modify-write; a lost bump
+    is a production compile stall no dashboard ever sees. The wrapper
+    body is plain Python, so hammering it directly races the exact
+    increment path trace time runs."""
+    import threading
+
+    stage = "hammer-stage"
+    wrapped = retrace.instrument(stage, lambda x: x)
+    before = retrace.snapshot().get(stage, 0)
+    n_threads, n_iters = 8, 2000
+    start = threading.Barrier(n_threads)
+
+    def worker():
+        start.wait()
+        for i in range(n_iters):
+            wrapped(i)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert retrace.snapshot()[stage] - before == n_threads * n_iters
+
+
+def test_metrics_sink_surfaces_retraces_as_counters():
+    """set_metrics_sink mirrors each trace into a retrace.<stage>
+    counter — the /metrics surface production alerts on (the server
+    installs the GLOBAL registry at Api construction)."""
+    from bucketeer_tpu.server.metrics import Metrics
+
+    sink = Metrics()
+    retrace.set_metrics_sink(sink)
+    try:
+        wrapped = retrace.instrument("sink-stage", lambda x: x + 1)
+        wrapped(1)
+        wrapped(2)
+    finally:
+        retrace.set_metrics_sink(None)
+    assert sink.report()["counters"]["retrace.sink-stage"] == 2
+    # A fresh jit trace reports through the same path.
+    import jax
+
+    sink2 = Metrics()
+    retrace.set_metrics_sink(sink2)
+    try:
+        fn = jax.jit(retrace.instrument("sink-jit-stage",
+                                        lambda x: x * 2))
+        fn(np.float32(1.0))
+        fn(np.float32(2.0))      # cached program: no new trace
+    finally:
+        retrace.set_metrics_sink(None)
+    assert sink2.report()["counters"]["retrace.sink-jit-stage"] == 1

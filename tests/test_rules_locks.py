@@ -1,0 +1,274 @@
+"""Lock-discipline lint (analysis/rules_locks.py): seeded
+unguarded-field bugs fire, the conventions (constructors, _locked
+suffix, lock-free reads) stay clean, and the repo itself is clean.
+"""
+import textwrap
+from pathlib import Path
+
+from bucketeer_tpu.analysis import lint, rules_locks
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(tmp_path, body):
+    root = tmp_path / "pkg"
+    (root / "engine").mkdir(parents=True)
+    (root / "__init__.py").write_text('"""fixture"""\n')
+    (root / "engine" / "__init__.py").write_text('"""fixture"""\n')
+    (root / "engine" / "mod.py").write_text(textwrap.dedent(body),
+                                            encoding="utf-8")
+    return rules_locks.run(lint.load_project(root))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# --- seeded bugs: the three shapes the rule targets --------------------
+
+def test_seeded_scheduler_style_unguarded_write(tmp_path):
+    """The merged-batch-queue shape: a deque guarded by a Condition in
+    the hot path, mutated lock-free on a second path."""
+    findings = _run(tmp_path, """\
+        import threading
+        from collections import deque
+
+
+        class Sched:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._jobs = deque()
+
+            def submit(self, job):
+                with self._cv:
+                    self._jobs.append(job)
+                    self._cv.notify_all()
+
+            def steal(self):
+                return self._jobs.popleft()      # missed `with self._cv`
+        """)
+    assert _rules(findings) == ["unguarded-field-write"]
+    assert findings[0].line == 16
+    assert "_jobs" in findings[0].message
+    assert "_cv" in findings[0].message
+
+
+def test_seeded_cache_style_unguarded_write(tmp_path):
+    """The tiered-cache shape: byte accounting guarded in put(), a new
+    reset path reassigning the dict without the lock."""
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}
+                self._bytes = 0
+
+            def put(self, key, arr):
+                with self._lock:
+                    self._entries[key] = arr
+                    self._bytes += arr.nbytes
+
+            def reset(self):
+                self._entries = {}               # unguarded reassign
+                self._bytes = 0
+        """)
+    assert _rules(findings) == ["unguarded-field-write"] * 2
+    assert {f.line for f in findings} == {16, 17}
+
+
+def test_seeded_metrics_style_unguarded_increment(tmp_path):
+    """The dataclass-lock shape (server/metrics.py): counters bumped
+    under the field(default_factory=Lock) lock everywhere except one
+    new method."""
+    findings = _run(tmp_path, """\
+        import threading
+        from dataclasses import dataclass, field
+
+
+        @dataclass
+        class Metrics:
+            counters: dict = field(default_factory=dict)
+            _lock: threading.Lock = field(
+                default_factory=threading.Lock)
+
+            def count(self, name):
+                with self._lock:
+                    self.counters[name] = self.counters.get(name, 0) + 1
+
+            def bulk(self, names):
+                for n in names:
+                    self.counters[n] = 1         # racing writes
+        """)
+    assert _rules(findings) == ["unguarded-field-write"]
+    assert findings[0].line == 17
+
+
+# --- conventions that must stay clean ----------------------------------
+
+def test_constructor_and_locked_suffix_are_exempt(tmp_path):
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Sched:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._waiting = []               # construction: exempt
+
+            def grant(self):
+                with self._lock:
+                    self._grant_next_locked()
+
+            def _grant_next_locked(self):
+                self._waiting.pop()              # caller holds the lock
+        """)
+    assert findings == []
+
+
+def test_unlocked_reads_are_tolerated(tmp_path):
+    """Lock-free fast-path reads (cache hits, stat snapshots) are a
+    documented pattern; only writes corrupt."""
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._bytes = 0
+
+            def put(self, n):
+                with self._lock:
+                    self._bytes += n
+
+            @property
+            def nbytes(self):
+                return self._bytes               # read: fine
+        """)
+    assert findings == []
+
+
+def test_nested_def_does_not_inherit_the_lock(tmp_path):
+    """A closure defined inside a `with self._lock:` block runs later,
+    wherever it is called — a write inside it is unguarded."""
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+                    def later():
+                        self._items.append(x)    # runs lock-free
+                    return later
+        """)
+    assert _rules(findings) == ["unguarded-field-write"]
+    assert findings[0].line == 14
+
+
+def test_nested_def_in_locked_method_is_not_lock_held(tmp_path):
+    """The _locked suffix covers the method body, not closures escaping
+    it: a callback defined inside _kick_locked runs later on some pool
+    thread with no lock — its write must still be flagged."""
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = []
+
+            def kick(self):
+                with self._lock:
+                    self._kick_locked()
+
+            def _kick_locked(self):
+                self._jobs.append(1)             # caller holds the lock
+
+                def cb():
+                    self._jobs.append(2)         # runs lock-free
+                return cb
+        """)
+    assert _rules(findings) == ["unguarded-field-write"]
+    assert findings[0].line == 17
+
+
+def test_class_without_locks_is_ignored(tmp_path):
+    findings = _run(tmp_path, """\
+        class Plain:
+            def __init__(self):
+                self._items = []
+
+            def add(self, x):
+                self._items.append(x)
+        """)
+    assert findings == []
+
+
+def test_other_locks_context_counts_as_held(tmp_path):
+    """Any of the class's known locks held at the access site counts:
+    cross-lock consistency is a different (weaker) signal than
+    no-lock-at-all, and flagging it would bury the corruption class
+    this rule exists for."""
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Condition()
+                self._n = 0
+
+            def via_a(self):
+                with self._a:
+                    self._n += 1
+
+            def via_b(self):
+                with self._b:
+                    self._n += 1
+        """)
+    assert findings == []
+
+
+# --- the gate: the repo itself -----------------------------------------
+
+def test_repo_is_clean_under_rules_locks():
+    project = lint.load_project(REPO / "bucketeer_tpu")
+    findings = rules_locks.run(project)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_repo_scheduler_and_caches_are_inferred():
+    """The rule must actually *see* the production discipline — an
+    empty inference would make the repo-clean gate vacuous."""
+    from bucketeer_tpu.analysis.rules_locks import _lock_fields
+    import ast
+
+    sched = (REPO / "bucketeer_tpu" / "engine" / "scheduler.py").read_text()
+    cls = [n for n in ast.walk(ast.parse(sched))
+           if isinstance(n, ast.ClassDef) and n.name == "EncodeScheduler"]
+    assert _lock_fields(cls[0]) == {"_lock", "_dq_cv"}
+
+    reader = (REPO / "bucketeer_tpu" / "converters"
+              / "reader.py").read_text()
+    names = {n.name: _lock_fields(n) for n in ast.walk(ast.parse(reader))
+             if isinstance(n, ast.ClassDef)}
+    assert names["_DecodeCache"] == {"_lock"}
+    assert names["TpuReader"] == {"_index_builds_lock"}
+
+    metrics = (REPO / "bucketeer_tpu" / "server"
+               / "metrics.py").read_text()
+    cls = [n for n in ast.walk(ast.parse(metrics))
+           if isinstance(n, ast.ClassDef) and n.name == "Metrics"]
+    assert _lock_fields(cls[0]) == {"_lock"}
