@@ -227,39 +227,50 @@ def _stage_profile(sink, prefixes=("encode.", "decode.")) -> dict:
 
 # --- configs -------------------------------------------------------------
 
+# The three Tier-1 modes the split compares: legacy host Tier-1 over
+# packed bitmaps, device CX/D + host MQ replay, and full-device Tier-1
+# (CX/D + MQ coder on device, host = block assembly only).
+_SPLIT_MODES = (("legacy", dict(device_cxd=False, device_mq=False)),
+                ("cxd", dict(device_cxd=True, device_mq=False)),
+                ("device_mq", dict(device_mq=True)))
+
+
 def _tier1_split_report(img, params) -> dict:
-    """Host-coding segment, legacy full Tier-1 vs device-CX/D MQ replay
-    (BUCKETEER_DEVICE_CXD): one instrumented encode per mode, reporting
-    the host seconds, the CX/D device segment, symbol throughput and the
-    measured overlap ratio — the numbers ISSUE 3's acceptance gate asks
-    for (host Tier-1 time per chunk down, overlap ratio up)."""
+    """Host-coding segment across the three Tier-1 modes (legacy /
+    MQ-replay / device-MQ): one instrumented encode per mode, reporting
+    the host seconds, the device Tier-1 segments, symbol and byte
+    throughput and the measured overlap ratio — plus re-timed
+    host-Tier-1-only numbers, whose ratios are the acceptance gates
+    (ISSUE 3: replay vs legacy; ISSUE 9: device-MQ host work <= 1/5 of
+    replay's — with device MQ the host's whole Tier-1 share is
+    assemble_mq_blocks)."""
     import dataclasses
 
-    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec import cxd as cxd_mod
+    from bucketeer_tpu.codec import encoder, t1_batch
     from bucketeer_tpu.server.metrics import Metrics
 
     # Two probes. Serial (the config's own tiling, usually one chunk):
-    # the host segment runs uncontended, so legacy-vs-replay seconds
+    # the host segment runs uncontended, so the per-mode host seconds
     # compare cleanly. Overlap (many single-tile chunks): the ratio the
     # pipeline actually achieves when host coding hides behind device
     # compute — on CPU the two sides share cores, which would skew the
     # serial timing if merged into one probe.
-    from bucketeer_tpu.codec import t1_batch
-
     out: dict = {}
     calls: dict = {}
-    for mode, flag in (("legacy", False), ("cxd", True)):
+    for mode, flags in _SPLIT_MODES:
         calls[mode] = []
         out[mode] = _tier1_split_one(
             encoder, Metrics, img,
-            dataclasses.replace(params, device_cxd=flag), flag,
+            dataclasses.replace(params, **flags), mode,
             capture=calls[mode])
     # The sink segments above include scheduling noise at smoke sizes;
-    # the speedup number re-times the captured host Tier-1 calls alone
+    # the speedup numbers re-time the captured host Tier-1 calls alone
     # (same inputs the measured encode used), min of 3 — this is "host
     # Tier-1 time per chunk" with nothing else on the cores.
     for mode, fn in (("legacy", t1_batch.encode_packed),
-                     ("cxd", t1_batch.encode_cxd)):
+                     ("cxd", t1_batch.encode_cxd),
+                     ("device_mq", cxd_mod.assemble_mq_blocks)):
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
@@ -269,8 +280,13 @@ def _tier1_split_report(img, params) -> dict:
         out[mode]["host_tier1_retimed_s"] = round(best, 4)
     legacy_s = out["legacy"]["host_tier1_retimed_s"]
     cxd_s = out["cxd"]["host_tier1_retimed_s"]
+    mq_s = out["device_mq"]["host_tier1_retimed_s"]
     out["host_tier1_speedup"] = (round(legacy_s / cxd_s, 2)
                                  if cxd_s > 0 else None)
+    # The ISSUE 9 acceptance number: host Tier-1 work with the MQ coder
+    # on device vs the MQ-replay mode's host share.
+    out["host_reduction_device_mq_vs_replay"] = (
+        round(cxd_s / mq_s, 2) if mq_s > 0 else None)
 
     side = min(128, img.shape[0], img.shape[1])
     ov_img = img[:side, :side]
@@ -278,12 +294,15 @@ def _tier1_split_report(img, params) -> dict:
     prev_tiles = os.environ.get("BUCKETEER_OVERLAP_TILES")
     os.environ["BUCKETEER_OVERLAP_TILES"] = "1"
     try:
+        # Overlap is a device-vs-host race; in device-MQ mode the host
+        # side is assembly-only (nothing to hide), so the probe covers
+        # the two modes with a real host segment.
         out["overlap_probe"] = {
             mode: _tier1_split_one(
                 encoder, Metrics, ov_img,
-                dataclasses.replace(ov_params, device_cxd=flag),
-                flag)["overlap_ratio"]
-            for mode, flag in (("legacy", False), ("cxd", True))}
+                dataclasses.replace(ov_params, **flags),
+                mode)["overlap_ratio"]
+            for mode, flags in _SPLIT_MODES[:2]}
     finally:
         if prev_tiles is None:
             os.environ.pop("BUCKETEER_OVERLAP_TILES", None)
@@ -292,17 +311,20 @@ def _tier1_split_report(img, params) -> dict:
     return out
 
 
-def _tier1_split_one(encoder, Metrics, img, p, flag,
+def _tier1_split_one(encoder, Metrics, img, p, mode,
                      capture: list | None = None) -> dict:
+    from bucketeer_tpu.codec import cxd as cxd_mod
     from bucketeer_tpu.codec import t1_batch
 
     encoder.encode_jp2(img, 8, p)               # warm: exclude compiles
     sink = Metrics()
     encoder.set_metrics_sink(sink)
-    orig = (t1_batch.encode_packed, t1_batch.encode_cxd)
+    orig = (t1_batch.encode_packed, t1_batch.encode_cxd,
+            cxd_mod.assemble_mq_blocks)
     if capture is not None:
         # Record the host Tier-1 inputs so the caller can re-time the
-        # host calls in isolation after the encode.
+        # host calls in isolation after the encode. In device-MQ mode
+        # the host's whole Tier-1 share is the block assembly.
         def cap_packed(*args):
             capture.append(args)
             return orig[0](*args)
@@ -311,13 +333,19 @@ def _tier1_split_one(encoder, Metrics, img, p, flag,
             capture.append((streams,))
             return orig[1](streams)
 
+        def cap_mq(*args):
+            capture.append(args)
+            return orig[2](*args)
+
         t1_batch.encode_packed = cap_packed
         t1_batch.encode_cxd = cap_cxd
+        cxd_mod.assemble_mq_blocks = cap_mq
     try:
         encoder.encode_jp2(img, 8, p)
     finally:
         encoder.set_metrics_sink(None)
-        t1_batch.encode_packed, t1_batch.encode_cxd = orig
+        (t1_batch.encode_packed, t1_batch.encode_cxd,
+         cxd_mod.assemble_mq_blocks) = orig
     rep = sink.report()
     st = rep["stages"]
     ov = rep.get("overlap", {}).get("encode", {})
@@ -326,11 +354,22 @@ def _tier1_split_one(encoder, Metrics, img, p, flag,
         "device_s": st["encode.device_dispatch"]["total_s"],
         "overlap_ratio": ov.get("overlap_ratio", 0.0),
     }
-    if flag:
+    if mode == "cxd":
         entry["mq_replay_s"] = st["encode.mq_replay"]["total_s"]
         entry["cxd_device_s"] = st["encode.cxd_device"]["total_s"]
         entry["symbols"] = st["encode.mq_replay"].get("items", 0)
         entry["symbols_per_s"] = st["encode.mq_replay"].get(
+            "items_per_s", 0)
+    elif mode == "device_mq":
+        entry["cxd_device_s"] = st["encode.cxd_device"]["total_s"]
+        entry["mq_device_s"] = st["encode.mq_device"]["total_s"]
+        entry["t1_device_total_s"] = st[
+            "encode.t1_device_total"]["total_s"]
+        entry["symbols"] = st["encode.t1_device_total"].get("items", 0)
+        entry["symbols_per_s"] = st["encode.t1_device_total"].get(
+            "items_per_s", 0)
+        entry["bytes"] = st["encode.mq_device"].get("items", 0)
+        entry["bytes_per_s"] = st["encode.mq_device"].get(
             "items_per_s", 0)
     return entry
 
@@ -384,12 +423,13 @@ def config1_single_4k(repeats: int) -> dict:
               "repeats": repeats}
     if _want_tier1_split():
         # On CPU, bound the jnp-scan 'device' cost: the host-segment
-        # comparison is per-chunk anyway, so a 256² slab is
-        # representative and keeps smoke CI fast.
+        # comparison is per-chunk anyway, so a 192² slab is
+        # representative and keeps smoke CI fast (the three-mode split
+        # runs the CX/D and MQ scans several times each).
         import jax
 
         split_img = (img if jax.default_backend() != "cpu"
-                     else img[:min(size, 256), :min(size, 256)])
+                     else img[:min(size, 192), :min(size, 192)])
         result["tier1_split"] = _tier1_split_report(split_img, params)
     return result
 

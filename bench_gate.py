@@ -25,9 +25,20 @@ Rules:
   against a non-CPU reference it is a platform mismatch by definition.
 - Getting faster never fails.
 
+Beyond the headline, the gate also checks the *per-stage* profile of
+the headline config (``stage_profile``: front-end dispatch, host
+coding, CX/D, MQ replay / device MQ, decode segments): a PR can keep
+the headline flat while quietly halving one stage's throughput and
+eating the margin another PR just bought. Stages gate at a looser
+threshold (``--stage-loss-pct``, default 30%) because per-stage
+seconds are noisier than the end-to-end number, compare only stages
+present in both runs (a mode that stopped running is a config change,
+not a regression), and apply only under the same strict-comparability
+rules as the headline (same platform, workload and machine class).
+
 Usage: ``python bench_gate.py <current.json> <reference.json>
-[--max-loss-pct=5] [--force]`` — both files may contain log noise; the
-last line starting with ``{`` is the report.
+[--max-loss-pct=5] [--stage-loss-pct=30] [--force]`` — both files may
+contain log noise; the last line starting with ``{`` is the report.
 """
 from __future__ import annotations
 
@@ -97,20 +108,84 @@ def check(current: dict, reference: dict,
     return loss_pct <= max_loss_pct, msg
 
 
+STAGE_LOSS_PCT = 30.0
+
+
+def _stage_profiles(report: dict) -> dict:
+    out = {}
+    for cfg_name, cfg in (report.get("configs") or {}).items():
+        prof = cfg.get("stage_profile") if isinstance(cfg, dict) else None
+        if prof:
+            out[cfg_name] = prof
+    return out
+
+
+def check_stages(current: dict, reference: dict,
+                 max_loss_pct: float = STAGE_LOSS_PCT) -> tuple:
+    """(ok, messages): per-stage throughput regressions between the two
+    runs' ``stage_profile`` maps. Gates only under the strict
+    comparability rules (same platform, workload *and* machine class —
+    per-stage seconds don't survive a hardware change even at the
+    relaxed headline threshold) and only for stages reporting a
+    throughput metric in both runs."""
+    if reference.get("platform") != current.get("platform"):
+        return True, ["stage gate skipped: platform mismatch"]
+    if reference.get("smoke") != current.get("smoke"):
+        return True, ["stage gate skipped: workload mismatch"]
+    if reference.get("machine") != current.get("machine"):
+        return True, ["stage gate skipped: machine-class mismatch "
+                      "(re-record the reference on this class)"]
+    if not current.get("device_run_valid", True):
+        return True, ["stage gate skipped: invalid device run"]
+    ref_profs, cur_profs = (_stage_profiles(reference),
+                            _stage_profiles(current))
+    ok, msgs = True, []
+    compared = 0
+    for cfg_name in sorted(set(ref_profs) & set(cur_profs)):
+        ref_st, cur_st = ref_profs[cfg_name], cur_profs[cfg_name]
+        for stage in sorted(set(ref_st) & set(cur_st)):
+            for key in ("mpixels_per_s", "items_per_s"):
+                rv = ref_st[stage].get(key)
+                cv = cur_st[stage].get(key)
+                if not rv or cv is None:
+                    continue
+                compared += 1
+                loss = (rv - cv) / rv * 100.0
+                if loss > max_loss_pct:
+                    ok = False
+                    msgs.append(
+                        f"{cfg_name}/{stage}: {cv:g} vs {rv:g} {key} "
+                        f"({loss:+.1f}% loss, limit {max_loss_pct:g}%)")
+                break           # one throughput metric per stage
+    if ok:
+        msgs.append(f"{compared} stage metric(s) within "
+                    f"{max_loss_pct:g}%")
+    return ok, msgs
+
+
 def main(argv: list) -> int:
     args = [a for a in argv if not a.startswith("--")]
     if len(args) != 2:
         print("usage: bench_gate.py <current.json> <reference.json> "
-              "[--max-loss-pct=N]", file=sys.stderr)
+              "[--max-loss-pct=N] [--stage-loss-pct=N]",
+              file=sys.stderr)
         return 2
     pct = 5.0
+    stage_pct = STAGE_LOSS_PCT
     force = "--force" in argv
     for a in argv:
         if a.startswith("--max-loss-pct="):
             pct = float(a.split("=", 1)[1])
+        if a.startswith("--stage-loss-pct="):
+            stage_pct = float(a.split("=", 1)[1])
     current = load_report(args[0])
     reference = load_report(args[1])
     ok, msg = check(current, reference, pct, force=force)
+    st_ok, st_msgs = check_stages(current, reference, stage_pct)
+    for m in st_msgs:
+        print(("bench-gate stages OK: " if st_ok
+               else "bench-gate stages FAIL: ") + m)
+    ok = ok and st_ok
     print(("bench-gate OK: " if ok else "bench-gate FAIL: ") + msg)
     if "relaxed cross-machine limit" in msg:
         # GitHub Actions annotation: make the relaxation loud in the

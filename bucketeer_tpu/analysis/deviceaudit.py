@@ -168,6 +168,26 @@ def registry() -> list:
         "cxd.scan.pallas/P2/N1",
         lambda: cxd.cxd_program(2, 0, pallas=True, interpret=True)
         + (cxd_args(1),)))
+    # Device-MQ chain (BUCKETEER_DEVICE_MQ): the raw-symbol CX/D
+    # variant feeding the MQ-coder scan, and the MQ scan itself in both
+    # implementations (the Pallas kernel in interpret mode).
+    entries.append(AuditProgram(
+        "cxd.scan.raw/P2/N1",
+        lambda: cxd.cxd_program(2, 0, pallas=False, raw=True)
+        + (cxd_args(1),)))
+
+    def mq_args(n):
+        return [sds((n, cxd.max_syms(2)), jnp.uint8),
+                sds((n, 2, 3), jnp.int32), sds((n,), jnp.int32),
+                sds((n,), jnp.int32)]
+
+    entries.append(AuditProgram(
+        "mq.scan/P2/S1024/N1",
+        lambda: cxd.mq_program(2, 1024, pallas=False) + (mq_args(1),)))
+    entries.append(AuditProgram(
+        "mq.scan.pallas/P2/S1024/N1",
+        lambda: cxd.mq_program(2, 1024, pallas=True, interpret=True)
+        + (mq_args(1),)))
 
     iplan_g = ddevice.make_inverse_plan(64, 64, 1, 2, True, 8, False,
                                         lambda lvl, name: 1.0)

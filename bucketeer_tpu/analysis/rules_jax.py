@@ -60,13 +60,16 @@ SYNC_METHODS = {"item", "tolist", "block_until_ready",
 # _host_stats, which several requests share after a merged
 # cross-request launch — a few KB of per-block stats), the
 # CX/D stream assembly (cxd.run_cxd — pass tables + row-granular symbol
-# payload), the mesh single-tile transform exit, and the decode
-# subsystem's device->host boundary (decode.device.run_inverse — the
-# reconstructed sample batch is the decoder's product; there is nothing
-# smaller to ship).
+# payload), the device-MQ byte-segment fetch (cxd.run_device_mq — pass
+# cursors + truncation snapshots + row-granular finished byte segments,
+# the only d2h traffic of the full-device Tier-1 chain), the mesh
+# single-tile transform exit, and the decode subsystem's device->host
+# boundary (decode.device.run_inverse — the reconstructed sample batch
+# is the decoder's product; there is nothing smaller to ship).
 D2H_SANCTIONED = {"fetch_payload", "gather_rows", "run_frontend",
                   "run_tiles", "run_tiles_sharded", "resolve_stats",
-                  "_host_stats", "run_cxd", "sharded_transform_tile",
+                  "_host_stats", "run_cxd", "run_device_mq",
+                  "sharded_transform_tile",
                   "run_inverse", "run_region_inverse"}
 D2H_SCOPES = ("codec", "parallel")
 

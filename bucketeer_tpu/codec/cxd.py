@@ -34,10 +34,24 @@ integer) as an unevaluated double-float pair — Dekker two-product /
 Knuth two-sum — which represents integer sums exactly to ~2^48. The
 host reconstitutes ``(hi + lo) / 4`` in float64 and lands on the same
 number the native coder would have produced.
+
+Device MQ coding (``BUCKETEER_DEVICE_MQ``): the second half of Tier-1 —
+the MQ arithmetic coder itself — also runs on device as a per-symbol
+byte-emitting scan chained after the CX/D scan (`_make_mq_step`, with a
+Pallas TPU kernel in codec/pallas/mq_scan.py sharing the same step).
+The device then holds finished per-pass byte segments; the host's
+``t1_encode_cxd`` MQ replay drops out of the hot path entirely and
+:func:`run_device_mq` fetches bytes + per-pass truncation snapshots and
+assembles ``t1.CodedBlock`` directly (:func:`assemble_mq_blocks`).
+Byte identity with the host ``MQEncoder`` — including byte stuffing,
+the 0xFF carry paths, flush, the trailing-0xFF drop and the per-pass
+``truncation_length`` snapshots — is the contract
+(tests/test_mq_device.py).
 """
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 from functools import lru_cache, partial
 
@@ -48,7 +62,7 @@ from jax import lax
 
 from ..analysis import retrace
 from ..config import truthy as cfg_truthy
-from .mq import CTX_RL, CTX_UNIFORM, MQEncoder
+from .mq import CTX_RL, CTX_UNIFORM, MQEncoder, QE_TABLE
 from .pipeline import donate_argnums_if_supported
 from .t1 import _SC, _ZC_HH, _ZC_LL_LH, BAND_CLS
 
@@ -326,25 +340,49 @@ def unpack6(packed: np.ndarray, n_syms: int) -> np.ndarray:
 
 
 def _use_pallas() -> bool:
+    """Whether the Pallas kernels are the device implementation.
+    ``BUCKETEER_CXD_PALLAS``: "auto" (default) = TPU backend only;
+    truthy forces it, falsy disables. A positive choice is then gated
+    on the Mosaic capability probe (codec/pallas/support.py): backends
+    whose PJRT plugin cannot compile Pallas kernels (the ``axon``
+    first-dispatch failures of BENCH_r02/r05) downgrade to the jnp scan
+    with a logged reason and a metrics counter instead of crashing at
+    first dispatch."""
     env = os.environ.get("BUCKETEER_CXD_PALLAS", "auto")
     if env == "auto":
-        return jax.default_backend() == "tpu"
-    return cfg_truthy(env)
+        want = jax.default_backend() == "tpu"
+    else:
+        want = cfg_truthy(env)
+    if not want:
+        return False
+    from .pallas import support
+
+    ok, reason = support.mosaic_supported()
+    if not ok:
+        support.note_downgrade("BUCKETEER_CXD_PALLAS", reason)
+        return False
+    return True
 
 
-def _cxd_body(impl, blocks, nbps, floors, cls, hs, ws):
+def _cxd_body(impl, raw, blocks, nbps, floors, cls, hs, ws):
     buf, counts, dh, dl, cur = impl(blocks, nbps, floors, cls, hs, ws)
+    if raw:
+        # Device-MQ mode: the symbol buffer stays in HBM as the input
+        # of the MQ scan (mq_program) — no 6-bit packing, no fetch.
+        return buf, counts, dh, dl, cur
     packed = pack6(buf).reshape(-1, PACKED_ROW_BYTES)
     return packed, counts, dh, dl, cur
 
 
 def cxd_program(P: int, frac_bits: int, pallas: bool | None = None,
-                interpret: bool = False):
+                interpret: bool = False, raw: bool = False):
     """(traceable fn, device donate_argnums) for one CX/D program —
     the construction :func:`_compiled_cxd` jits, shared with the device
     audit (analysis/deviceaudit.py), which lowers both implementations
     on CPU (the Pallas kernel in interpret mode). ``pallas=None``
-    defers to the runtime choice (:func:`_use_pallas`). The donate spec
+    defers to the runtime choice (:func:`_use_pallas`). ``raw`` returns
+    the unpacked (N, max_syms) symbol buffer instead of packed 6-bit
+    rows — the device-MQ chain's intermediate. The donate spec
     is empty by verified fact: no output aval matches the (N, 64, 64)
     int32 block input (symbol rows are uint8, tables are per-pass), so
     XLA would drop the alias silently."""
@@ -354,16 +392,16 @@ def cxd_program(P: int, frac_bits: int, pallas: bool | None = None,
     else:
         impl = jax.vmap(partial(_cxd_single, P, frac_bits,
                                 jnp.asarray(scan_xs(P))))
-    return retrace.instrument("cxd", partial(_cxd_body, impl)), ()
+    return retrace.instrument("cxd", partial(_cxd_body, impl, raw)), ()
 
 
 @lru_cache(maxsize=64)
-def _compiled_cxd(P: int, frac_bits: int):
-    """One jitted CX/D program per (plane count, fixed-point shift).
-    The Pallas-vs-jnp choice is made here, outside the traced body
-    (cached with the program — flip BUCKETEER_CXD_PALLAS before first
-    use)."""
-    fn, donate = cxd_program(P, frac_bits)
+def _compiled_cxd(P: int, frac_bits: int, raw: bool = False):
+    """One jitted CX/D program per (plane count, fixed-point shift,
+    output form). The Pallas-vs-jnp choice is made here, outside the
+    traced body (cached with the program — flip BUCKETEER_CXD_PALLAS
+    before first use)."""
+    fn, donate = cxd_program(P, frac_bits, raw=raw)
     return jax.jit(fn, donate_argnums=donate_argnums_if_supported(*donate))
 
 
@@ -468,6 +506,33 @@ def reference_cxd(mags: np.ndarray, signs: np.ndarray, band: str,
     return blk, np.asarray(rec.symbols, dtype=np.uint8), rec.boundaries
 
 
+def _pad_chunk_meta(N: int, nbps: np.ndarray, floors: np.ndarray,
+                    bandnames: list, hs: np.ndarray, ws: np.ndarray,
+                    P: int):
+    """Per-block metadata padded to the device batch size N: the
+    padding tail gets floor >= nbp (dead blocks that emit nothing).
+    The scan length and symbol capacity scale with the plane count;
+    planes above every block's MSB emit nothing, so P is clamped to
+    the chunk's realized maximum (bounded variants: one compile per
+    distinct effective P, at most layout.P of them). Shared by the
+    replay-mode (:func:`run_cxd`) and device-MQ
+    (:func:`run_device_mq`) chunk entries — the padding invariant must
+    not diverge between them."""
+    n = len(nbps)
+    P = max(1, min(P, int(nbps.max()) if n else 1))
+    nbps_d = np.zeros(N, np.int32)
+    nbps_d[:n] = nbps
+    floors_d = np.full(N, P, np.int32)     # padding: floor >= nbp -> dead
+    floors_d[:n] = floors
+    cls = np.zeros(N, np.int32)
+    cls[:n] = [BAND_CLS[b] for b in bandnames]
+    hs_d = np.full(N, CBLK, np.int32)
+    hs_d[:n] = hs
+    ws_d = np.full(N, CBLK, np.int32)
+    ws_d[:n] = ws
+    return P, nbps_d, floors_d, cls, hs_d, ws_d
+
+
 def run_cxd(blocks_dev, nbps: np.ndarray, floors: np.ndarray,
             bandnames: list, hs: np.ndarray, ws: np.ndarray,
             P: int, frac_bits: int) -> CxdStreams:
@@ -481,22 +546,8 @@ def run_cxd(blocks_dev, nbps: np.ndarray, floors: np.ndarray,
     from . import frontend
 
     n = len(nbps)
-    # The scan length and symbol capacity scale with the plane count;
-    # planes above every block's MSB emit nothing, so clamp to the
-    # chunk's realized maximum (bounded variants: one compile per
-    # distinct effective P, at most layout.P of them).
-    P = max(1, min(P, int(nbps.max()) if n else 1))
-    N = int(blocks_dev.shape[0])
-    nbps_d = np.zeros(N, np.int32)
-    nbps_d[:n] = nbps
-    floors_d = np.full(N, P, np.int32)     # padding: floor >= nbp -> dead
-    floors_d[:n] = floors
-    cls = np.zeros(N, np.int32)
-    cls[:n] = [BAND_CLS[b] for b in bandnames]
-    hs_d = np.full(N, CBLK, np.int32)
-    hs_d[:n] = hs
-    ws_d = np.full(N, CBLK, np.int32)
-    ws_d[:n] = ws
+    P, nbps_d, floors_d, cls, hs_d, ws_d = _pad_chunk_meta(
+        int(blocks_dev.shape[0]), nbps, floors, bandnames, hs, ws, P)
 
     packed, counts, dh, dl, cur = _compiled_cxd(P, frac_bits)(
         blocks_dev, jnp.asarray(nbps_d), jnp.asarray(floors_d),
@@ -511,8 +562,23 @@ def run_cxd(blocks_dev, nbps: np.ndarray, floors: np.ndarray,
             f"CX/D stream overflow: {int(totals.max())} symbols exceed "
             f"the static capacity {max_syms(P)} (P={P})")
 
-    rpb = rows_per_block(P)
-    rows_needed = -(-totals // SYMS_PER_ROW)
+    payload, row_offsets = _fetch_block_rows(
+        packed, -(-totals // SYMS_PER_ROW), rows_per_block(P),
+        PACKED_ROW_BYTES)
+    return CxdStreams(payload, row_offsets[:-1], nbps.astype(np.int32),
+                      offsets, types, planes, nsyms, dists,
+                      int(totals.sum()))
+
+
+def _fetch_block_rows(rows_dev, rows_needed: np.ndarray, rpb: int,
+                      row_bytes: int):
+    """Row-granular device->host fetch shared by the symbol-stream and
+    byte-segment payloads: block b owns rows [b*rpb, (b+1)*rpb) of the
+    device array and ships only its first ``rows_needed[b]``. Returns
+    (payload (R, row_bytes) uint8, row_offsets (n+1,) int64)."""
+    from . import frontend
+
+    n = len(rows_needed)
     row_offsets = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(rows_needed, out=row_offsets[1:])
     src = np.empty(int(row_offsets[-1]), dtype=np.int64)
@@ -520,7 +586,318 @@ def run_cxd(blocks_dev, nbps: np.ndarray, floors: np.ndarray,
         o = row_offsets[b]
         src[o:row_offsets[b + 1]] = (b * rpb
                                      + np.arange(rows_needed[b]))
-    payload = frontend.gather_rows(packed, src, PACKED_ROW_BYTES)
-    return CxdStreams(payload, row_offsets[:-1], nbps.astype(np.int32),
-                      offsets, types, planes, nsyms, dists,
-                      int(totals.sum()))
+    return frontend.gather_rows(rows_dev, src, row_bytes), row_offsets
+
+
+# --- the device MQ coder (BUCKETEER_DEVICE_MQ) --------------------------
+#
+# A per-symbol scan over the CX/D symbol buffer replicating the host
+# MQEncoder register for register: A (16-bit interval), C (32-bit code,
+# uint32 with the host's & 0xFFFFFFFF masks as native wraparound), CT
+# (shift countdown), the 47-entry Qe state table, per-context
+# index/MPS, the spec's byte-stuffing byteout (Annex C.2.5 incl. the
+# carry that increments the previous byte) and the two-byteout flush
+# with the software-convention trailing-0xFF drop. Per-pass truncation
+# points are captured in-scan: whenever the symbol cursor crosses a
+# pass boundary (the CX/D scan's ``counts`` snapshots), the byte count
+# at that moment is recorded — exactly what ``MQEncoder.n_bytes()``
+# returns when ``truncation_length`` is called at the end of a pass.
+
+MQ_ROW_BYTES = 512       # byte-segment fetch granularity (gather_rows)
+
+_QE_ARR = np.asarray(QE_TABLE, dtype=np.int32)     # (47, 4)
+
+
+def mq_capacity(n_steps: int) -> int:
+    """Static byte capacity for ``n_steps`` symbols, rounded to fetch
+    rows. Each MQ decision is one binary symbol; the coder's sustained
+    worst case is well under 2 bits/decision (a 15-shift emission needs
+    an LPS at a tiny-Qe state, reachable only through long runs of
+    sub-bit MPS coding), so 4 bits/symbol plus transient slack is a
+    hard ceiling in practice — and :func:`run_device_mq` verifies the
+    realized cursor against this capacity and fails loudly rather than
+    ship a silently truncated stream."""
+    cap = n_steps // 2 + 64
+    return -(-cap // MQ_ROW_BYTES) * MQ_ROW_BYTES
+
+
+def _mq_byteout(cond, c, ct, buf, cur, cap):
+    """Annex C.2.5 BYTEOUT, masked by ``cond``: emit one byte of C into
+    ``buf`` at ``cur`` (stuffing after 0xFF, carry into the previous
+    byte), update C/CT. ``cap`` is the out-of-bounds drop index."""
+    last = buf[cur - 1].astype(jnp.int32)
+    is_ff = last == 0xFF
+    carry = jnp.logical_not(is_ff) & (c >= jnp.uint32(0x8000000))
+    newlast = jnp.where(carry, last + 1, last)
+    stuff = is_ff | (carry & (newlast == 0xFF))
+    c2 = jnp.where(carry & (newlast == 0xFF),
+                   c & jnp.uint32(0x7FFFFFF), c)
+    out_b = jnp.where(stuff, c2 >> jnp.uint32(20),
+                      c2 >> jnp.uint32(19)) & jnp.uint32(0xFF)
+    buf = buf.at[jnp.where(cond & carry, cur - 1, cap)].set(
+        newlast.astype(jnp.uint8), mode="drop")
+    buf = buf.at[jnp.where(cond, cur, cap)].set(
+        out_b.astype(jnp.uint8), mode="drop")
+    c = jnp.where(cond, jnp.where(stuff, c2 & jnp.uint32(0xFFFFF),
+                                  c2 & jnp.uint32(0x7FFFF)), c)
+    ct = jnp.where(cond, jnp.where(stuff, 7, 8), ct)
+    return c, ct, buf, cur + cond.astype(jnp.int32)
+
+
+def _mq_renorm(cond, a, c, ct, buf, cur, cap):
+    """Annex C.2.4 RENORME as a masked fixed-trip loop: at most 15
+    shifts bring A (>= 1 after the interval update) back above 0x8000;
+    every CT expiry emits a byte."""
+    active = cond
+    for _ in range(15):
+        a = jnp.where(active, (a << 1) & 0xFFFF, a)
+        c = jnp.where(active, c << jnp.uint32(1), c)
+        ct = ct - active.astype(jnp.int32)
+        c, ct, buf, cur = _mq_byteout(active & (ct == 0), c, ct, buf,
+                                      cur, cap)
+        active = active & ((a & 0x8000) == 0)
+    return a, c, ct, buf, cur
+
+
+def _mq_init(P: int, cap: int):
+    """Carry: (a, c, ct, cursor-into-buf, byte buffer, per-context Qe
+    indices, per-context MPS, per-pass byte snapshots). buf[0] is the
+    software convention's dummy pre-byte (MQEncoder.buf[0])."""
+    # Initial context states (mq.initial_states) built by scalar
+    # updates, not an embedded array — Pallas kernels cannot capture
+    # array constants.
+    idxs = (jnp.zeros((19,), jnp.int32).at[0].set(4)
+            .at[CTX_RL].set(3).at[CTX_UNIFORM].set(46))
+    return (jnp.int32(0x8000), jnp.uint32(0), jnp.int32(12),
+            jnp.int32(1), jnp.zeros((cap,), jnp.uint8), idxs,
+            jnp.zeros((19,), jnp.int32), jnp.zeros((P, 3), jnp.int32))
+
+
+def _make_mq_step(cap: int, symbuf, total, counts, tables=None):
+    """Build the per-symbol MQ encode step for one block — shared
+    verbatim between the vmapped lax.scan path and the Pallas kernel
+    (pallas/mq_scan.py), like :func:`_make_step` for the CX/D scan.
+
+    ``symbuf``: (max_syms,) uint8 symbols (ctx | d << 5); ``total``:
+    the block's realized symbol cursor; ``counts``: the (P, 3) pass
+    cursor snapshots the CX/D scan produced (pass-boundary detection).
+    ``tables``: optional (qe_tab (47, 4) int32,) — the Pallas kernel
+    passes it as a kernel input; None embeds it."""
+    if tables is None:
+        tables = (jnp.asarray(_QE_ARR),)
+    (qe_tab,) = tables
+
+    def step(carry, s):
+        a, c, ct, cur, buf, idxs, mpss, snaps = carry
+        live = s < total
+        sym = symbuf[s].astype(jnp.int32)
+        d = sym >> 5
+        ctx = sym & 31
+        idx = idxs[ctx]
+        qe = qe_tab[idx, 0]
+        mps = mpss[ctx]
+        is_mps = d == mps
+        a1 = a - qe
+        renorm_mps = (a1 & 0x8000) == 0
+        lt = a1 < qe
+        # Interval update (C.2.2/C.2.3 with conditional exchange): the
+        # four (MPS/LPS x exchange) outcomes collapse to two selects.
+        new_a = jnp.where(is_mps == lt, qe, a1)
+        add_c = jnp.where(is_mps != lt, qe, 0)
+        new_idx = jnp.where(is_mps,
+                            jnp.where(renorm_mps, qe_tab[idx, 1], idx),
+                            qe_tab[idx, 2])
+        new_mps = jnp.where(jnp.logical_not(is_mps)
+                            & (qe_tab[idx, 3] == 1), 1 - mps, mps)
+        idxs = idxs.at[ctx].set(jnp.where(live, new_idx, idx))
+        mpss = mpss.at[ctx].set(jnp.where(live, new_mps, mps))
+        a = jnp.where(live, new_a, a)
+        c = c + jnp.where(live, add_c, 0).astype(jnp.uint32)
+        need_rn = live & jnp.where(is_mps, renorm_mps, True)
+        a, c, ct, buf, cur = _mq_renorm(need_rn, a, c, ct, buf, cur,
+                                        cap)
+        # Pass boundary: bytes emitted so far == MQEncoder.n_bytes() at
+        # the moment truncation_length() would have been called.
+        snaps = jnp.where(live & (counts == s + 1), cur - 1, snaps)
+        return (a, c, ct, cur, buf, idxs, mpss, snaps), None
+
+    return step
+
+
+def _mq_flush(carry, do_flush, cap: int):
+    """Annex C.2.9 FLUSH (masked by ``do_flush`` — blocks with no
+    coding passes ship no bytes, mirroring ``replay_block``'s
+    ``mq.flush() if n_passes else b""``), plus the software
+    convention's trailing-0xFF drop. Returns (buf, snaps, data_len,
+    cursor)."""
+    a, c, ct, cur, buf, idxs, mpss, snaps = carry
+    tempc = c + a.astype(jnp.uint32)
+    c = c | jnp.uint32(0xFFFF)
+    c = jnp.where(c >= tempc, c - jnp.uint32(0x8000), c)
+    c = c << ct.astype(jnp.uint32)
+    c, ct, buf, cur = _mq_byteout(do_flush, c, ct, buf, cur, cap)
+    c = c << ct.astype(jnp.uint32)
+    c, ct, buf, cur = _mq_byteout(do_flush, c, ct, buf, cur, cap)
+    nbytes = cur - 1
+    last = buf[cur - 1].astype(jnp.int32)
+    dlen = nbytes - (last == 0xFF).astype(jnp.int32)
+    dlen = jnp.where(do_flush, dlen, 0)
+    return buf, snaps, dlen, cur
+
+
+def _mq_single(P, n_steps, cap, symbuf, counts, total, flush_flag):
+    step = _make_mq_step(cap, symbuf, total, counts)
+    carry, _ = lax.scan(step, _mq_init(P, cap),
+                        jnp.arange(n_steps, dtype=jnp.int32))
+    return _mq_flush(carry, flush_flag != 0, cap)
+
+
+def _mq_body(impl, buf, counts, totals, flags):
+    bytebuf, snaps, dlen, cur = impl(buf, counts, totals, flags)
+    return bytebuf.reshape(-1, MQ_ROW_BYTES), snaps, dlen, cur
+
+
+def mq_program(P: int, n_steps: int, pallas: bool | None = None,
+               interpret: bool = False):
+    """(traceable fn, device donate_argnums) for one MQ-coder program —
+    the construction :func:`_compiled_mq` jits, shared with the device
+    audit (analysis/deviceaudit.py). Inputs: the CX/D scan's raw
+    (N, max_syms) uint8 symbol buffer, its (N, P, 3) pass cursors, the
+    (N,) realized totals and (N,) flush flags; outputs byte-segment
+    rows, per-pass byte snapshots, data lengths and cursors.
+    ``n_steps`` is the pow-2-bucketed scan length (<= max_syms(P)).
+    The donate spec is empty by verified fact: the uint8 symbol input
+    reshapes to differently-shaped uint8 byte rows, so XLA would drop
+    the alias silently (the audit's forced probe proves it)."""
+    cap = mq_capacity(n_steps)
+    if _use_pallas() if pallas is None else pallas:
+        from .pallas.mq_scan import mq_pallas
+        impl = partial(mq_pallas, P, n_steps, cap, interpret=interpret)
+    else:
+        impl = jax.vmap(partial(_mq_single, P, n_steps, cap))
+    return retrace.instrument("mq", partial(_mq_body, impl)), ()
+
+
+@lru_cache(maxsize=64)
+def _compiled_mq(P: int, n_steps: int):
+    fn, donate = mq_program(P, n_steps)
+    return jax.jit(fn, donate_argnums=donate_argnums_if_supported(*donate))
+
+
+def _mq_steps_bucket(tmax: int, P: int) -> int:
+    """Pow-2 scan-length bucket covering the chunk's realized maximum
+    symbol cursor (compile variants stay O(log max_syms) per P, like
+    the frontend's batch buckets), capped at the static capacity."""
+    n = 256
+    while n < tmax:
+        n <<= 1
+    return min(n, max_syms(P))
+
+
+@dataclass
+class MqDeviceResult:
+    """One chunk's device-MQ outcome: finished code-blocks plus the
+    segment timings/volumes the encoder's metrics report."""
+    blocks: list               # [t1.CodedBlock]
+    total_syms: int
+    total_bytes: int
+    cxd_s: float               # device context-modeling segment
+    mq_s: float                # device MQ-coder segment (incl. fetch)
+    host_s: float              # host assembly (the entire host share)
+
+
+def assemble_mq_blocks(nbps: np.ndarray, floors: np.ndarray,
+                       snaps: np.ndarray, dlens: np.ndarray,
+                       dists: np.ndarray, payload: np.ndarray,
+                       row_offsets: np.ndarray) -> list:
+    """Host assembly of device-MQ outputs into ``t1.CodedBlock``s — the
+    whole host share of Tier-1 in device-MQ mode (no MQ replay, no
+    context modeling; bench.py re-times exactly this to measure the
+    host-work reduction).
+
+    ``snaps``: (n, P, 3) per-pass byte counts; ``dlens``: (n,) final
+    data lengths; ``dists``: (n, P, 3) float64 exact distortions;
+    ``payload``: (R, MQ_ROW_BYTES) fetched byte rows, each block's
+    segment starting with the dummy pre-byte; ``row_offsets``: (n+1,)
+    first payload row per block."""
+    from . import t1
+    from .rate import truncation_lengths
+
+    out = []
+    for b in range(len(nbps)):
+        nbp, flo = int(nbps[b]), int(floors[b])
+        dlen = int(dlens[b])
+        if nbp <= flo:
+            out.append(t1.CodedBlock(b"", 0))
+            continue
+        raw = payload[int(row_offsets[b]):int(row_offsets[b + 1])]
+        data = raw.reshape(-1)[1:1 + dlen].tobytes()
+        # One vectorized truncation-point map per block; the pass walk
+        # below only indexes it (this loop is the host's entire Tier-1
+        # share — keep numpy dispatch out of the per-pass path).
+        cums = truncation_lengths(snaps[b], dlen)
+        passes = []
+        for p in range(nbp - 1, flo - 1, -1):
+            for t in ((2,) if p == nbp - 1 else (0, 1, 2)):
+                passes.append(t1.PassInfo(t, p, int(cums[p, t]),
+                                          float(dists[b, p, t])))
+        out.append(t1.CodedBlock(data, nbp, passes))
+    return out
+
+
+def run_device_mq(blocks_dev, nbps: np.ndarray, floors: np.ndarray,
+                  bandnames: list, hs: np.ndarray, ws: np.ndarray,
+                  P: int, frac_bits: int) -> MqDeviceResult:
+    """Tier-1 for one chunk entirely on device: CX/D scan (symbols stay
+    in HBM) chained into the MQ-coder scan, then a row-granular fetch
+    of the finished byte segments + per-pass truncation snapshots.
+    Output blocks are byte-identical to ``t1_batch.encode_cxd`` over
+    ``run_cxd`` streams (and therefore to the legacy packed path)."""
+    n = len(nbps)
+    N = int(blocks_dev.shape[0])
+    P, nbps_d, floors_d, cls, hs_d, ws_d = _pad_chunk_meta(
+        N, nbps, floors, bandnames, hs, ws, P)
+
+    t0 = time.perf_counter()
+    buf, counts, dh, dl, cur = _compiled_cxd(P, frac_bits, raw=True)(
+        blocks_dev, jnp.asarray(nbps_d), jnp.asarray(floors_d),
+        jnp.asarray(cls), jnp.asarray(hs_d), jnp.asarray(ws_d))
+    # counts stays device-resident — it is the MQ program's boundary
+    # input; only the small distortion/cursor arrays come host-side.
+    dh_h, dl_h, cur_h = (np.asarray(jax.device_get(x))
+                         for x in (dh, dl, cur))
+    t_cxd = time.perf_counter() - t0
+
+    if n and int(cur_h[:n].max()) > max_syms(P):
+        raise ValueError(
+            f"CX/D stream overflow: {int(cur_h[:n].max())} symbols "
+            f"exceed the static capacity {max_syms(P)} (P={P})")
+    dist = (dh_h.astype(np.float64) + dl_h.astype(np.float64)) / 4.0
+    flags = (nbps_d > floors_d).astype(np.int32)
+
+    t0 = time.perf_counter()
+    n_steps = _mq_steps_bucket(int(cur_h.max()) if N else 1, P)
+    cap = mq_capacity(n_steps)
+    rows, snaps, dlen, curb = _compiled_mq(P, n_steps)(
+        buf, counts, cur, jnp.asarray(flags))
+    snaps_h, dlen_h, curb_h = (np.asarray(jax.device_get(x))[:n]
+                               for x in (snaps, dlen, curb))
+    if n and int(curb_h.max()) > cap:
+        raise ValueError(
+            f"MQ byte-segment overflow: {int(curb_h.max())} bytes "
+            f"exceed the static capacity {cap} ({n_steps} symbol "
+            "steps) — the coded stream expanded past the 4-bit/symbol "
+            "budget")
+    # Row-granular byte fetch: only the rows each live block filled
+    # (the block's segment includes the leading dummy pre-byte).
+    payload, row_offsets = _fetch_block_rows(
+        rows, -(-(dlen_h + 1) // MQ_ROW_BYTES) * (dlen_h > 0),
+        cap // MQ_ROW_BYTES, MQ_ROW_BYTES)
+    t_mq = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = assemble_mq_blocks(nbps, floors, snaps_h, dlen_h, dist,
+                             payload, row_offsets)
+    t_host = time.perf_counter() - t0
+    return MqDeviceResult(out, int(cur_h[:n].sum()),
+                          int(dlen_h.sum()), t_cxd, t_mq, t_host)

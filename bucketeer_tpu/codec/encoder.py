@@ -78,6 +78,34 @@ def _device_cxd(params: EncodeParams) -> bool:
     return cfg_truthy(os.environ.get("BUCKETEER_DEVICE_CXD"))
 
 
+def _device_mq(params: EncodeParams) -> bool:
+    """Whether this encode runs Tier-1 entirely on device (CX/D scan +
+    MQ arithmetic coder, codec/cxd.py run_device_mq): the explicit
+    EncodeParams.device_mq wins, else BUCKETEER_DEVICE_MQ. Implies the
+    CX/D split (the MQ scan consumes the device symbol buffer)."""
+    if params.device_mq is not None:
+        return bool(params.device_mq)
+    return cfg_truthy(os.environ.get("BUCKETEER_DEVICE_MQ"))
+
+
+class _ImmediateResult:
+    """Future-quack for Tier-1 work finished inline. Device-MQ mode
+    bypasses the host Tier-1 pool entirely — the blocks come back
+    assembled from the device fetch — but the pipeline's ordered
+    reassembly (``futs`` submission order) stays uniform."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value) -> None:
+        self._value = value
+
+    def done(self) -> bool:
+        return True
+
+    def result(self):
+        return self._value
+
+
 # Optional per-stage timing/counter sink (server.metrics.Metrics). The
 # server installs its instance at boot so /metrics shows the encoder's
 # device-dispatch vs host-coding segments and the measured overlap.
@@ -148,6 +176,14 @@ class EncodeParams:
     # decides; the converter wires the bucketeer.tpu.device.cxd config
     # key through here. Byte-identical output either way.
     device_cxd: bool | None = None
+    # Full Tier-1 on device: chain the MQ arithmetic coder after the
+    # CX/D scan (codec/cxd.py run_device_mq) so the device emits
+    # finished per-pass byte segments and the host does Tier-2 assembly
+    # only — no MQ replay, no host Tier-1 pool. None = the
+    # BUCKETEER_DEVICE_MQ env flag decides; the converter wires the
+    # bucketeer.tpu.device.mq config key through here. Implies the
+    # CX/D split. Byte-identical output in every mode.
+    device_mq: bool | None = None
 
     @classmethod
     def kakadu_recipe(cls, lossless: bool,
@@ -815,14 +851,17 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     chunks, tile_records, qcd_values = _build_chunks(
         groups, plans, used_mct, gains, weight_of_slot, norms)
 
-    use_cxd = _device_cxd(params)
+    use_mq = _device_mq(params)
+    use_cxd = use_mq or _device_cxd(params)
     frac_bits = 0 if params.lossless else FRAC_BITS
-    tm = {"device": 0.0, "host": 0.0, "cxd": 0.0, "mq": 0.0}
+    tm = {"device": 0.0, "host": 0.0, "cxd": 0.0, "mq": 0.0,
+          "mq_dev": 0.0}
     # The shared scheduler pool may run two of this encode's chunks
     # concurrently (the private executor never did); serialize the
     # timing accumulator so segments stay exact.
     tm_lock = threading.Lock()
     n_syms = [0]
+    n_mq_bytes = [0]
     floor_lam = [0.0]
     t_wall0 = time.perf_counter()
 
@@ -848,8 +887,8 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         batch = np.stack([img[y0:y0 + chunk.plan.tile_h,
                               x0:x0 + chunk.plan.tile_w]
                           for _, y0, x0 in chunk.members])
-        chunk.pending = dispatch_fn(
-            chunk.plan, batch, mode="cxd" if use_cxd else "rows")
+        mode = "mq" if use_mq else ("cxd" if use_cxd else "rows")
+        chunk.pending = dispatch_fn(chunk.plan, batch, mode=mode)
         _tm_add("device", time.perf_counter() - t0)
 
     def resolve(chunk: _Chunk) -> None:
@@ -886,6 +925,32 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     def fetch_and_submit(pool, chunk: _Chunk, floors: np.ndarray,
                          futs: list, release_rows: bool) -> None:
         t0 = time.perf_counter()
+        if use_mq:
+            # Tier-1 never touches the host: the device runs CX/D and
+            # the MQ coder back to back (symbols stay in HBM between
+            # the two programs) and ships finished byte segments; the
+            # shared host Tier-1 pool is bypassed entirely.
+            res = cxd_mod.run_device_mq(
+                chunk.fres.blocks, chunk.fres.nbps, floors,
+                chunk.bandnames, chunk.hs, chunk.ws,
+                chunk.fres.layout.P, frac_bits)
+            _tm_add("device", res.cxd_s + res.mq_s)
+            _tm_add("cxd", res.cxd_s)
+            _tm_add("mq_dev", res.mq_s)
+            n_syms[0] += res.total_syms
+            n_mq_bytes[0] += res.total_bytes
+            if release_rows:
+                chunk.fres.blocks = None    # free the HBM staging buffer
+            blocks = res.blocks
+            th0 = time.perf_counter()
+            if not params.lossless:
+                _correct_distortions(blocks, chunk.fres)
+            # The whole host share: assembly + distortion correction.
+            _tm_add("host", res.host_s + time.perf_counter() - th0)
+            # No back-pressure check: nothing is in flight — every
+            # entry this branch appends is already resolved.
+            futs.append(_ImmediateResult(blocks))
+            return
         if use_cxd:
             streams = cxd_mod.run_cxd(
                 chunk.fres.blocks, chunk.fres.nbps, floors,
@@ -1022,7 +1087,21 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         _metrics_sink.record("encode.device_dispatch", tm["device"],
                              pixels=h * w)
         _metrics_sink.record("encode.host_code", tm["host"], pixels=h * w)
-        if use_cxd:
+        if use_mq:
+            # Full-device Tier-1 segments: context modeling, the MQ
+            # coder (items=bytes -> bytes/s), and their sum (items=
+            # symbols -> symbols/s). encode.host_code above is the
+            # whole host share (block assembly only).
+            _metrics_sink.record("encode.cxd_device", tm["cxd"],
+                                 pixels=h * w)
+            _metrics_sink.record("encode.mq_device", tm["mq_dev"],
+                                 pixels=h * w, items=n_mq_bytes[0])
+            _metrics_sink.record("encode.t1_device_total",
+                                 tm["cxd"] + tm["mq_dev"],
+                                 pixels=h * w, items=n_syms[0])
+            _metrics_sink.count("encode.cxd_symbols", n_syms[0])
+            _metrics_sink.count("encode.mq_device_bytes", n_mq_bytes[0])
+        elif use_cxd:
             # The Tier-1 split's own segments: device context modeling
             # vs host MQ replay, plus symbol throughput (/metrics shows
             # items_per_s on the replay stage).

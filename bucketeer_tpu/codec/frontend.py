@@ -302,7 +302,12 @@ def dispatch_frontend(plan: TilePlan, tiles: np.ndarray,
     """Queue transform + blockify + stats for a (B, h, w[, C]) tile
     batch on the device and return without waiting for the result.
     ``mode="cxd"`` keeps the raw blockified coefficients on device for
-    the CX/D stage instead of packing bit-plane bitmaps."""
+    the CX/D stage instead of packing bit-plane bitmaps; ``mode="mq"``
+    is the full-device Tier-1 chain (CX/D scan + MQ coder,
+    cxd.run_device_mq) — the front-end program is identical to "cxd"
+    (one compiled variant serves both; the modes diverge downstream),
+    the distinct name exists so the scheduler and metrics can tell the
+    pipelines apart."""
     if tiles.ndim == 3:
         tiles = tiles[..., None]
     # Dtype audit at the host->device boundary: the device program's
@@ -319,9 +324,10 @@ def dispatch_frontend(plan: TilePlan, tiles: np.ndarray,
         tiles = np.concatenate(
             [tiles, np.zeros((pad,) + tiles.shape[1:], tiles.dtype)])
     layout = layout_for(plan)
-    out, stats = _compiled_frontend(plan, layout.P, mode)(
+    prog_mode = "cxd" if mode == "mq" else mode
+    out, stats = _compiled_frontend(plan, layout.P, prog_mode)(
         jnp.asarray(tiles))
-    if mode == "rows":
+    if prog_mode == "rows":
         return PendingFrontend(layout, b, out, stats)
     return PendingFrontend(layout, b, None, stats, blocks=out)
 

@@ -1,23 +1,26 @@
 """Hand-written Pallas TPU kernels.
 
-First (and so far only) kernel: the EBCOT CX/D stripe scan
-(:mod:`.cxd_scan`) — the device half of the Tier-1 split that ships
-context-modeling symbol streams, not work, to the host MQ coder
-(codec/cxd.py, ``BUCKETEER_DEVICE_CXD``). It keeps a code-block's
-significance state and symbol buffer resident in VMEM for the whole
-plane walk instead of letting XLA spill the batched scan state through
-HBM.
+Two kernels carry Tier-1 on device:
 
-Selection: codec/cxd.py picks the Pallas kernel on the TPU backend and
-the plain-jnp ``lax.scan`` formulation elsewhere (CPU dev mode, tests);
-``BUCKETEER_CXD_PALLAS=1/0`` forces either way. Both implementations
-share one step function, and interpret-mode parity tests
-(tests/test_cxd.py) pin them to each other and to the codec/t1.py
-reference coder.
+- :mod:`.cxd_scan` — the EBCOT CX/D stripe scan (context modeling),
+  keeping a code-block's significance state and symbol buffer resident
+  in VMEM for the whole plane walk instead of letting XLA spill the
+  batched scan state through HBM (``BUCKETEER_DEVICE_CXD``).
+- :mod:`.mq_scan` — the MQ arithmetic coder, a per-symbol byte-emitting
+  scan chained after the CX/D scan so finished per-pass byte segments
+  (not symbol streams, not work) are all that ever reaches the host
+  (``BUCKETEER_DEVICE_MQ``).
 
-The earlier plan recorded here — fusing the bit-plane packing of the
-packed-bitmap path into a kernel — is superseded: the CX/D split removes
-that packing from the hot path entirely. When adding kernels, read the
-TPU guide under /opt/skills/guides/ first and keep a jnp fallback for
-the CPU backend.
+Selection: codec/cxd.py picks the Pallas kernels on the TPU backend and
+the plain-jnp ``lax.scan`` formulations elsewhere (CPU dev mode,
+tests); ``BUCKETEER_CXD_PALLAS=1/0`` forces either way, behind the
+Mosaic capability probe (:mod:`.support`) that downgrades to jnp — with
+a logged reason and a metrics counter — on backends whose PJRT plugin
+cannot compile Pallas programs. Every kernel shares its step function
+with the jnp path, and interpret-mode parity tests (tests/test_cxd.py,
+tests/test_mq_device.py) pin them to each other and to the
+codec/t1.py + codec/mq.py reference coders.
+
+When adding kernels, read the TPU guide under /opt/skills/guides/ first
+and keep a jnp fallback for the CPU backend.
 """

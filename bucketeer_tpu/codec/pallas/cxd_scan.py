@@ -16,16 +16,26 @@ working set (state ~17 KB, symbol buffer ~100 KB, coefficients 16 KB)
 is pinned in VMEM for the kernel's lifetime and only the finished
 streams leave the core.
 
-Status: semantics are locked to the jnp path by interpret-mode parity
-tests (tests/test_cxd.py) on every CI run; the compiled-on-real-TPU
-path is selected by ``BUCKETEER_CXD_PALLAS`` (default: auto — TPU
-backend only) and can be disabled with ``BUCKETEER_CXD_PALLAS=0`` if a
-Mosaic version rejects the scalar-indexed updates. The device audit
-(analysis/deviceaudit.py, CI ``audit`` job) also lowers the
-interpret-mode program on CPU every PR — via ``cxd.cxd_program(...,
-pallas=True, interpret=True)`` — so structural drift in the kernel's
-emitted ops (and any host callback or f64 creeping in) fails a PR even
-without TPU hardware in the loop.
+Compiled-TPU status: the kernel is a product path, not a parity
+artifact. The grid's block axis is declared ``parallel``
+(:func:`_tpu_params`) so Mosaic may fan code-blocks out across
+TensorCores — every grid cell reads and writes disjoint slices — and
+the batch axis is pow-2 bucketed upstream (frontend/scheduler batch
+buckets flow through ``run_cxd``/``run_device_mq`` unchanged) so a
+long-running service compiles O(log max-batch) kernel variants, not one
+per chunk size. Selection is ``BUCKETEER_CXD_PALLAS`` (default: auto —
+TPU backend only) behind the Mosaic capability probe (support.py):
+backends that cannot compile Pallas programs downgrade to the jnp scan
+with a logged reason + metrics counter instead of dying at first
+dispatch (the BENCH_r02/r05 axon failure mode). Semantics stay locked
+to the jnp path by interpret-mode parity tests (tests/test_cxd.py) on
+every CI run, and the device audit (analysis/deviceaudit.py, CI
+``audit`` job) lowers the interpret-mode program on CPU every PR — via
+``cxd.cxd_program(..., pallas=True, interpret=True)`` — so structural
+drift in the kernel's emitted ops (and any host callback or f64
+creeping in) fails a PR even without TPU hardware in the loop; the
+measured-throughput side (symbols/s, bytes/s) is the bench's
+``tier1_split`` report.
 """
 from __future__ import annotations
 
@@ -44,6 +54,27 @@ except ImportError:                     # pragma: no cover
 from .. import cxd
 
 CBLK = cxd.CBLK
+
+
+def _tpu_params(interpret: bool) -> dict:
+    """Mosaic compiler params for the Tier-1 kernels: the single grid
+    axis iterates independent code-blocks (disjoint input/output
+    slices), so it is declared ``parallel`` — the compiler may split it
+    across TensorCores instead of running the blocks as one sequential
+    grid walk. Interpret mode (and jaxlibs without the TPU extension)
+    takes no params; jax renames the params class across versions, so
+    resolve it defensively."""
+    if interpret or pltpu is None:
+        return {}
+    for name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            try:
+                return {"compiler_params":
+                        cls(dimension_semantics=("parallel",))}
+            except TypeError:           # pragma: no cover - version skew
+                continue
+    return {}                           # pragma: no cover - version skew
 
 
 def _kernel(P: int, frac_bits: int, n_steps: int,
@@ -115,6 +146,7 @@ def cxd_pallas(P: int, frac_bits: int, blocks, nbps, floors, cls, hs, ws,
             jax.ShapeDtypeStruct((n, 1), jnp.int32),
         ),
         interpret=interpret,
+        **_tpu_params(interpret),
     )(blocks.astype(jnp.int32), meta, zc, jnp.asarray(sc_c),
       jnp.asarray(sc_x))
     return buf, counts, dh, dl, cur[:, 0]

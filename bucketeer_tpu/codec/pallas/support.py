@@ -1,0 +1,90 @@
+"""Mosaic/Pallas capability probe + graceful downgrade bookkeeping.
+
+BENCH_r02/r05 died at the *first compiled dispatch* on the experimental
+``axon`` platform — after ``jax.devices()`` had succeeded — and a
+Pallas kernel is the most backend-demanding program this codebase
+ships: a PJRT plugin can run plain XLA yet reject Mosaic lowering
+outright. So the Pallas gates (``BUCKETEER_CXD_PALLAS``, and through it
+the device-MQ kernel behind ``BUCKETEER_DEVICE_MQ``) no longer take the
+flag's word for it: a positive choice is verified by compiling and
+dispatching a trivial ``pallas_call`` once per process, and a failing
+probe *downgrades* to the jnp scan — same semantics, byte-identical
+output — with a logged reason and an ``encode.pallas_downgrades``
+metrics counter instead of crashing the encode.
+
+The probe result is cached for the process lifetime (backend identity
+cannot change under JAX once initialized); tests reset it via
+:func:`reset_probe`.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+LOG = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+_PROBE: tuple | None = None       # (ok, reason)
+_NOTED: set = set()               # flags already logged
+_SINK = None                      # server.metrics.Metrics-like
+
+
+def set_metrics_sink(sink) -> None:
+    """Install a metrics sink with ``count(name, n=1)`` (the server
+    wires server.metrics.GLOBAL at boot); None disables."""
+    global _SINK
+    _SINK = sink
+
+
+def reset_probe() -> None:
+    """Forget the cached probe result and logged flags (tests only)."""
+    global _PROBE
+    with _LOCK:
+        _PROBE = None
+        _NOTED.clear()
+
+
+def _run_probe() -> tuple:
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _probe_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + 1
+
+        out = pl.pallas_call(
+            _probe_kernel,
+            out_shape=jax.ShapeDtypeStruct((8,), jnp.int32),
+        )(jnp.arange(8, dtype=jnp.int32))
+        jax.block_until_ready(out)
+        return True, ""
+    except Exception as exc:        # any compile/dispatch failure
+        return False, (f"{type(exc).__name__}: "
+                       + str(exc).splitlines()[0][:160])
+
+
+def mosaic_supported() -> tuple:
+    """(ok, reason): can this backend compile and run a Pallas kernel?
+    Probed once per process with a real compiled dispatch — the same
+    code path a production kernel's first launch takes."""
+    global _PROBE
+    with _LOCK:
+        if _PROBE is None:
+            _PROBE = _run_probe()
+        return _PROBE
+
+
+def note_downgrade(flag: str, reason: str) -> None:
+    """Record one Pallas->jnp downgrade: log the reason once per flag,
+    bump the ``encode.pallas_downgrades`` counter every time so the
+    /metrics surface shows the fleet is not running the kernels it was
+    asked to."""
+    if flag not in _NOTED:
+        _NOTED.add(flag)
+        LOG.warning(
+            "%s requested but this backend cannot run Pallas/Mosaic "
+            "kernels (%s); downgrading to the jnp scan (byte-identical, "
+            "slower)", flag, reason or "probe failed")
+    if _SINK is not None:
+        _SINK.count("encode.pallas_downgrades")

@@ -126,6 +126,19 @@ def estimate_floors(nbps: np.ndarray, newsig: np.ndarray,
     return np.minimum(floors, nbps).astype(np.int32), float(hi)
 
 
+def truncation_lengths(byte_snaps, data_len):
+    """Feasible truncation points from device-emitted per-pass byte
+    counts (codec/cxd.py device-MQ mode): the MQ coder's conservative
+    rule — bytes emitted at the pass boundary plus 4 bytes of
+    decodable-prefix slack (``MQEncoder.truncation_length``) — capped
+    at the flushed stream length, exactly as the host replay caps its
+    recorded lengths. PCRD's hulls (:func:`allocate`) and the realized
+    cut (:func:`cut_slope`) consume these; byte parity with the
+    host-MQ path requires this mapping bit for bit."""
+    return np.minimum(np.asarray(byte_snaps, dtype=np.int64) + 4,
+                      int(data_len))
+
+
 def cut_slope(blocks: list, weights: list,
               target_bytes: float | None) -> float:
     """Approximate realized PCRD cut: the marginal R-D slope at the

@@ -59,6 +59,11 @@ CONVERSION_TYPE = "bucketeer.conversion.type"
 # CX/D streams through the host MQ coder (codec/cxd.py). Truthy enables,
 # "0"/empty disables, absent defers to the BUCKETEER_DEVICE_CXD env.
 DEVICE_CXD = "bucketeer.tpu.device.cxd"
+# Full Tier-1 on device: chain the MQ arithmetic coder after the CX/D
+# scan so the host only assembles finished byte segments (codec/cxd.py
+# run_device_mq). Truthy enables, "0"/empty disables, absent defers to
+# the BUCKETEER_DEVICE_MQ env. Implies the CX/D split.
+DEVICE_MQ = "bucketeer.tpu.device.mq"
 # JAX persistent compilation cache directory: repeated bench/server runs
 # reuse compiled XLA programs instead of recompiling at boot. Env analog:
 # BUCKETEER_COMPILE_CACHE (converters/tpu.py wires both).
@@ -88,7 +93,8 @@ ALL_KEYS = (
     FILESYSTEM_CSV_MOUNT, FILESYSTEM_PREFIX, SLACK_OAUTH_TOKEN,
     SLACK_CHANNEL_ID, SLACK_ERROR_CHANNEL_ID, SLACK_WEBHOOK_URL,
     FEATURE_FLAGS, TPU_LOSSY_RATE, TPU_BATCH_SIZE, TPU_MESH_SHAPE,
-    MESH_MIN_PIXELS, CONVERSION_TYPE, DEVICE_CXD, COMPILE_CACHE,
+    MESH_MIN_PIXELS, CONVERSION_TYPE, DEVICE_CXD, DEVICE_MQ,
+    COMPILE_CACHE,
     SCHED_QUEUE_DEPTH, SCHED_MAX_CONCURRENT, SCHED_POOL_SIZE,
     SCHED_WINDOW_MS, SCHED_DEADLINE_S, DECODE_CACHE_MB,
 )

@@ -65,6 +65,12 @@ class BatchConverterWorker:
             converter.device_cxd = cfg.truthy(cxd_flag)
             LOG.info("device CX/D Tier-1 split %s by config",
                      "enabled" if converter.device_cxd else "disabled")
+        mq_flag = config.get_str(cfg.DEVICE_MQ)
+        if mq_flag is not None and hasattr(converter, "device_mq"):
+            converter.device_mq = cfg.truthy(mq_flag)
+            LOG.info("full-device Tier-1 (MQ coder on device) %s by "
+                     "config",
+                     "enabled" if converter.device_mq else "disabled")
         cache_dir = config.get_str(cfg.COMPILE_CACHE)
         if cache_dir:
             from ..converters.tpu import maybe_enable_compile_cache

@@ -18,10 +18,14 @@ access and host Tier-1 capacity instead:
   programs are reused, not retraced. Each request gets back a sliced
   view of the merged result — per-tile results are bit-identical to a
   solo launch because every front-end reduction is within-tile.
-  CX/D-mode chunks (``BUCKETEER_DEVICE_CXD``) are not merged — their
-  blockified coefficients stay HBM-resident for a separate device stage
-  whose program is shaped per chunk — but they still flow through the
-  same device thread and host pool.
+  CX/D- and device-MQ-mode chunks (``BUCKETEER_DEVICE_CXD`` /
+  ``BUCKETEER_DEVICE_MQ``) are not merged — their blockified
+  coefficients stay HBM-resident for separate device stages whose
+  programs are shaped per chunk — but they still flow through the same
+  device thread. With device MQ active the host Tier-1 pool below is
+  bypassed outright: chunks come back from the device as finished
+  code-blocks (codec/cxd.run_device_mq) and the host's share is block
+  assembly on the request thread.
 - **Shared host Tier-1** — MQ replay / packed Tier-1 runs on one pool
   sized to host cores (``t1_encode_cxd``/``t1_encode_packed`` release
   the GIL, proven in tests/test_native_t1.py), with per-request ordered
@@ -125,7 +129,9 @@ class _DeviceJob:
     @property
     def key(self):
         # Merge-compatibility: identical jitted program + concatenable
-        # host batch. "rows" only — cxd launches are shaped per chunk.
+        # host batch. "rows" only — cxd/mq launches are shaped per
+        # chunk (their downstream device stages bucket on realized
+        # symbol counts).
         return (self.plan, self.mode, self.tiles.dtype.str,
                 self.tiles.shape[1:])
 

@@ -91,6 +91,12 @@ class Api:
         # test-time assertion.
         from ..analysis import retrace
         retrace.set_metrics_sink(self.metrics)
+        # Pallas/Mosaic capability downgrades (codec/pallas/support.py):
+        # a backend that cannot compile the Tier-1 kernels falls back to
+        # the jnp scans and bumps encode.pallas_downgrades here, so a
+        # fleet silently running without its kernels is visible.
+        from ..codec.pallas import support as pallas_support
+        pallas_support.set_metrics_sink(self.metrics)
         # Decode work is admitted through the same scheduler as encodes
         # (typed read-priority jobs): tile reads share the bounded
         # queue's 503 backpressure but outrank queued encodes, and the

@@ -100,3 +100,82 @@ def test_main_exit_codes(tmp_path):
     assert bench_gate.main([str(cur), str(ref),
                             "--max-loss-pct=60"]) == 0
     assert bench_gate.main([str(cur)]) == 2
+
+
+# --- per-stage regression checks -----------------------------------------
+
+_M = {"arch": "x86_64", "cpu_count": 4}
+
+
+def _staged(value, stages, **kw):
+    return _rep(value, machine=_M, smoke=True, configs={
+        "1_single_4k_rate3": {"value": value,
+                              "stage_profile": stages}}, **kw)
+
+
+def _stage(mpix=None, items=None):
+    out = {"total_s": 1.0, "count": 1}
+    if mpix is not None:
+        out["mpixels_per_s"] = mpix
+    if items is not None:
+        out["items_per_s"] = items
+    return out
+
+
+def test_stage_within_tolerance_passes():
+    ref = _staged(1.0, {"encode.host_code": _stage(mpix=2.0),
+                        "encode.mq_device": _stage(items=1e6)})
+    cur = _staged(1.0, {"encode.host_code": _stage(mpix=1.8),
+                        "encode.mq_device": _stage(items=0.9e6)})
+    ok, msgs = bench_gate.check_stages(cur, ref, 30.0)
+    assert ok, msgs
+    assert any("2 stage metric(s)" in m for m in msgs)
+
+
+def test_stage_regression_fails_even_with_flat_headline():
+    """The case the stage gate exists for: headline flat, one stage
+    quietly halved."""
+    ref = _staged(1.0, {"encode.host_code": _stage(mpix=2.0),
+                        "encode.device_dispatch": _stage(mpix=3.0)})
+    cur = _staged(1.0, {"encode.host_code": _stage(mpix=0.9),
+                        "encode.device_dispatch": _stage(mpix=3.0)})
+    ok, msgs = bench_gate.check_stages(cur, ref, 30.0)
+    assert not ok
+    assert any("encode.host_code" in m and "loss" in m for m in msgs)
+
+
+def test_stage_gate_only_compares_shared_stages():
+    """A stage present in only one run (a mode toggled, a segment
+    added) is a config change, not a regression."""
+    ref = _staged(1.0, {"encode.mq_replay": _stage(items=1e7)})
+    cur = _staged(1.0, {"encode.mq_device": _stage(items=1e5)})
+    ok, msgs = bench_gate.check_stages(cur, ref, 30.0)
+    assert ok
+    assert any("0 stage metric(s)" in m for m in msgs)
+
+
+def test_stage_gate_skips_on_mismatch():
+    ref = _staged(1.0, {"encode.host_code": _stage(mpix=2.0)})
+    bad = _staged(1.0, {"encode.host_code": _stage(mpix=0.1)})
+    for mutate, needle in (
+            (dict(platform="tpu"), "platform"),
+            (dict(smoke=False), "workload"),
+            (dict(machine={"arch": "arm64", "cpu_count": 8}),
+             "machine-class"),
+            (dict(device_run_valid=False), "invalid device run")):
+        cur = dict(bad)
+        cur.update(mutate)
+        ok, msgs = bench_gate.check_stages(cur, ref, 30.0)
+        assert ok and any(needle in m for m in msgs), (mutate, msgs)
+
+
+def test_main_gates_stages(tmp_path):
+    cur = tmp_path / "cur.json"
+    ref = tmp_path / "ref.json"
+    ref.write_text(json.dumps(
+        _staged(1.0, {"encode.host_code": _stage(mpix=2.0)})) + "\n")
+    cur.write_text(json.dumps(
+        _staged(1.0, {"encode.host_code": _stage(mpix=0.5)})) + "\n")
+    assert bench_gate.main([str(cur), str(ref)]) == 1
+    assert bench_gate.main([str(cur), str(ref),
+                            "--stage-loss-pct=90"]) == 0

@@ -1,0 +1,422 @@
+"""Device MQ coder (codec/cxd.py MQ scan + codec/pallas/mq_scan.py) vs
+the host MQEncoder and the MQ-replay path.
+
+The contract under test, layered:
+
+1. **Byte-identity oracle** — the per-symbol device scan reproduces the
+   host ``MQEncoder`` register for register on arbitrary
+   (context, decision) streams: identical bytes through every byteout
+   path (plain emit, 0xFF stuffing, the carry that increments the
+   previous byte, carry *into* 0xFF), identical flush (including the
+   software convention's trailing-0xFF drop), and identical per-pass
+   ``n_bytes`` snapshots at arbitrary boundaries. A pinned seed is
+   asserted to actually hit every path so coverage can't silently
+   evaporate.
+2. **Chain equivalence** — ``run_device_mq`` (CX/D scan -> MQ scan ->
+   byte-segment fetch -> host assembly) produces code-blocks equal to
+   the replay path (``t1_batch.encode_cxd`` over ``run_cxd`` streams)
+   field for field: data, truncation lengths, pass structure,
+   bit-identical distortions.
+3. **Kernel parity** — the Pallas MQ kernel (interpret mode on CPU)
+   equals the vmapped ``lax.scan`` path bit for bit; on a real TPU the
+   compiled kernels are checked against the same reference.
+4. **End to end** — ``BUCKETEER_DEVICE_MQ`` encodes byte-identical
+   files to the host-MQ path (lossless gray, rate-targeted RGB, 16-bit,
+   multi-tile) and reports the encode.mq_device /
+   encode.t1_device_total segments.
+"""
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bucketeer_tpu.codec import cxd, encoder, rate as rate_mod, t1_batch
+from bucketeer_tpu.codec.encoder import EncodeParams
+from bucketeer_tpu.codec.mq import MQEncoder
+from bucketeer_tpu.server.metrics import Metrics
+
+P_TEST = 5
+
+
+class CountingMQ(MQEncoder):
+    """Host reference instrumented to classify every byteout path."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.paths = {"stuff": 0, "plain": 0, "carry": 0, "carry_ff": 0}
+
+    def _byteout(self) -> None:
+        if self.buf[-1] == 0xFF:
+            self.paths["stuff"] += 1
+        elif self.c < 0x8000000:
+            self.paths["plain"] += 1
+        elif self.buf[-1] + 1 == 0xFF:
+            self.paths["carry_ff"] += 1
+        else:
+            self.paths["carry"] += 1
+        super()._byteout()
+
+
+def _host_encode(syms, boundaries):
+    """Encode a symbol stream on the host coder, recording n_bytes at
+    each boundary cursor — what truncation_length snapshots."""
+    mq = CountingMQ()
+    snaps, bi = [], 0
+    while bi < len(boundaries) and boundaries[bi] == 0:
+        snaps.append(0)                 # pass ended before any symbol
+        bi += 1
+    for i, s in enumerate(syms):
+        mq.encode(int(s) >> 5, int(s) & 31)
+        while bi < len(boundaries) and boundaries[bi] == i + 1:
+            snaps.append(mq.n_bytes())
+            bi += 1
+    while bi < len(boundaries):
+        snaps.append(mq.n_bytes())
+        bi += 1
+    pre_flush_len = len(mq.buf) - 1
+    data = mq.flush()
+    return mq, data, snaps, pre_flush_len
+
+
+_ORACLE_STEPS = 8192      # one shared compile for every oracle trial
+
+
+def _device_encode(syms, counts, P=2):
+    n = len(syms)
+    assert n <= _ORACLE_STEPS
+    cap = cxd.mq_capacity(_ORACLE_STEPS)
+    symbuf = np.zeros(_ORACLE_STEPS, np.uint8)
+    symbuf[:n] = syms
+    buf, snaps, dlen, cur = jax.jit(
+        partial(cxd._mq_single, P, _ORACLE_STEPS, cap))(
+        jnp.asarray(symbuf), jnp.asarray(counts), jnp.int32(n),
+        jnp.int32(1 if n else 0))
+    buf = np.asarray(buf)
+    return (buf[1:1 + int(dlen)].tobytes(),
+            np.asarray(snaps).reshape(-1), int(cur))
+
+
+def _random_stream(seed, n):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 19, n)
+            | (rng.integers(0, 2, n) << 5)).astype(np.uint8)
+
+
+def test_mq_oracle_all_paths_byte_identical():
+    """Pinned stream hitting every byteout path (plain / stuff / carry /
+    carry-into-0xFF) *and* the trailing-0xFF flush drop: device bytes
+    and boundary snapshots equal the host coder's."""
+    syms = _random_stream(7, 6000)      # seed searched for full coverage
+    bnd = np.array([0, 500, 1000, 2500, 2500, 6000], np.int64)
+    mq, data, snaps, pre_flush = _host_encode(syms, list(bnd))
+    assert all(v > 0 for v in mq.paths.values()), mq.paths
+    assert pre_flush + 2 > len(data), "trailing-0xFF drop not exercised"
+    got, dsnaps, _ = _device_encode(syms, bnd.reshape(2, 3))
+    assert got == data
+    assert list(dsnaps) == snaps
+
+
+def test_mq_oracle_stream_variants():
+    """Short/degenerate streams: every context coded, all-MPS runs,
+    all-LPS runs (conditional exchange + switch), single symbol, and
+    the empty stream (no passes -> no bytes)."""
+    cases = [
+        np.arange(19, dtype=np.uint8),                    # one per ctx
+        np.zeros(400, np.uint8),                          # all MPS d=0
+        np.full(400, 32 | 0, np.uint8),                   # all d=1 ctx0
+        np.array([18 | 32], np.uint8),                    # single symbol
+        _random_stream(1, 37),
+    ]
+    for syms in cases:
+        n = len(syms)
+        bnd = np.linspace(0, n, 6).astype(np.int64)
+        _, data, snaps, _ = _host_encode(syms, list(bnd))
+        got, dsnaps, _ = _device_encode(syms, bnd.reshape(2, 3))
+        assert got == data, f"stream of {n}"
+        assert list(dsnaps) == snaps
+    # Empty stream with the flush flag off: replay ships b"".
+    got, dsnaps, cur = _device_encode(np.zeros(0, np.uint8),
+                                      np.zeros((2, 3), np.int64))
+    assert got == b"" and cur == 1 and list(dsnaps) == [0] * 6
+
+
+def test_truncation_lengths_rule():
+    """rate.truncation_lengths is MQEncoder.truncation_length + the
+    replay path's final-length cap."""
+    got = rate_mod.truncation_lengths(np.array([0, 3, 10]), 9)
+    np.testing.assert_array_equal(got, [4, 7, 9])
+    assert int(rate_mod.truncation_lengths(2, 100)) == 6
+
+
+def _random_block(rng, h, w, max_bits=P_TEST, density=0.3):
+    mags = ((rng.random((h, w)) < density)
+            * rng.integers(0, 1 << max_bits, size=(h, w))).astype(
+        np.uint32)
+    negs = rng.random((h, w)) < 0.5
+    return mags, negs
+
+
+def test_run_device_mq_matches_replay(rng):
+    """The full device chain equals the replay path block for block:
+    bytes, pass structure, truncation lengths, bit-identical
+    distortions — across bands, floors, partial and all-zero blocks."""
+    n = 5
+    blocks = np.zeros((n, 64, 64), np.int32)
+    metas = []
+    for i in range(n):
+        h = int(rng.integers(1, 65))
+        w = int(rng.integers(1, 65))
+        mags, negs = _random_block(rng, h, w)
+        if i == 3:
+            mags[:] = 0
+        blocks[i, :h, :w] = mags.astype(np.int64) * np.where(negs, -1, 1)
+        metas.append((mags, negs, ["LL", "HL", "LH", "HH", "LL"][i],
+                      h, w))
+    nbps = np.array([int(m.max()).bit_length() for m, *_ in metas],
+                    np.int32)
+    floors = np.array([0, 1, 0, 0, 5], np.int32)
+    bands = [b for *_, b, _, _ in metas]
+    hs = np.array([m[3] for m in metas], np.int32)
+    ws = np.array([m[4] for m in metas], np.int32)
+
+    streams = cxd.run_cxd(jnp.asarray(blocks), nbps, floors, bands,
+                          hs, ws, P_TEST, 0)
+    ref = t1_batch.encode_cxd(streams)
+    res = cxd.run_device_mq(jnp.asarray(blocks), nbps, floors, bands,
+                            hs, ws, P_TEST, 0)
+    assert res.total_syms == streams.total_syms
+    assert res.total_bytes == sum(len(b.data) for b in ref)
+    for i, (g, r) in enumerate(zip(res.blocks, ref)):
+        assert g.data == r.data, f"block {i}"
+        assert g.n_bitplanes == r.n_bitplanes
+        assert len(g.passes) == len(r.passes)
+        for gp, rp in zip(g.passes, r.passes):
+            assert gp.cum_length == rp.cum_length
+            assert gp.pass_type == rp.pass_type
+            assert gp.bitplane == rp.bitplane
+            assert gp.dist_reduction == rp.dist_reduction
+
+
+def test_mq_pallas_interpret_matches_jnp(rng):
+    """The Pallas MQ kernel (interpret mode) and the vmapped lax.scan
+    share one step function; prove bit-identity anyway — byte buffer,
+    snapshots, data lengths, cursors."""
+    from bucketeer_tpu.codec.pallas.mq_scan import mq_pallas
+
+    P, n_steps = 2, 1024
+    cap = cxd.mq_capacity(n_steps)
+    msym = cxd.max_syms(P)
+    N = 3
+    sym = (rng.integers(0, 19, (N, msym))
+           | (rng.integers(0, 2, (N, msym)) << 5)).astype(np.uint8)
+    totals = np.array([900, 0, 1024], np.int32)
+    counts = np.stack([
+        np.sort(rng.integers(0, t + 1, P * 3)).reshape(P, 3)
+        for t in totals]).astype(np.int32)
+    flags = (totals > 0).astype(np.int32)
+    args = (jnp.asarray(sym), jnp.asarray(counts), jnp.asarray(totals),
+            jnp.asarray(flags))
+    ref = jax.vmap(lambda *a: cxd._mq_single(P, n_steps, cap, *a))(*args)
+    got = mq_pallas(P, n_steps, cap, *args, interpret=True)
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+def test_e2e_device_mq_byte_identical_lossless(rng):
+    img = _photo(rng, 64, 64)
+    params = EncodeParams(lossless=True, levels=2)
+    legacy = encoder.encode_jp2(
+        img, 8, dataclasses.replace(params, device_cxd=False,
+                                    device_mq=False))
+    split = encoder.encode_jp2(
+        img, 8, dataclasses.replace(params, device_mq=True))
+    assert legacy == split
+
+
+def test_e2e_device_mq_rate_target_env_flag(rng, monkeypatch):
+    """Rate-targeted lossy (floors, PCRD, margin retries) through the
+    env flag, with the new /metrics segments asserted: distortion and
+    truncation parity must hold or layers shift."""
+    img = _photo(rng, 64, 64, comps=3)
+    params = EncodeParams(lossless=False, levels=2, rate=1.5,
+                          n_layers=3, base_delta=0.5)
+    monkeypatch.delenv("BUCKETEER_DEVICE_MQ", raising=False)
+    monkeypatch.delenv("BUCKETEER_DEVICE_CXD", raising=False)
+    legacy = encoder.encode_jp2(img, 8, params)
+    monkeypatch.setenv("BUCKETEER_DEVICE_MQ", "1")
+    sink = Metrics()
+    encoder.set_metrics_sink(sink)
+    try:
+        split = encoder.encode_jp2(img, 8, params)
+    finally:
+        encoder.set_metrics_sink(None)
+    assert legacy == split
+    st = sink.report()["stages"]
+    assert "encode.cxd_device" in st
+    assert "encode.mq_replay" not in st     # host replay never ran
+    assert st["encode.mq_device"]["items"] > 0          # bytes
+    assert st["encode.t1_device_total"]["items"] > 0    # symbols
+    counters = sink.report()["counters"]
+    assert counters["encode.mq_device_bytes"] == \
+        st["encode.mq_device"]["items"]
+
+
+def test_e2e_device_mq_multitile(rng):
+    """A multi-tile grid (the chunked pipeline, several chunks each
+    assembling several blocks) through the device-MQ path."""
+    img8 = _photo(rng, 96, 64)
+    params8 = EncodeParams(lossless=True, levels=2, tile_size=64)
+    legacy = encoder.encode_jp2(
+        img8, 8, dataclasses.replace(params8, device_cxd=False,
+                                     device_mq=False))
+    split = encoder.encode_jp2(
+        img8, 8, dataclasses.replace(params8, device_mq=True))
+    assert legacy == split
+
+
+@pytest.mark.slow
+def test_e2e_device_mq_16bit(rng):
+    """16-bit lossless through the device-MQ path. Slow-marked: the
+    16-bit level shift puts ~15 planes in play whatever the content,
+    and the jnp scans pay ~a minute of CPU for that (the TPU kernels
+    don't care)."""
+    y, x = np.mgrid[0:64, 0:64]
+    img16 = (600 + 380 * np.sin(x / 9.0) * np.cos(y / 7.0)
+             + rng.normal(0, 12, (64, 64))).astype(np.uint16)
+    params16 = EncodeParams(lossless=True, levels=2)
+    legacy = encoder.encode_jp2(
+        img16, 16, dataclasses.replace(params16, device_cxd=False,
+                                       device_mq=False))
+    split = encoder.encode_jp2(
+        img16, 16, dataclasses.replace(params16, device_mq=True))
+    assert legacy == split
+
+
+def test_pallas_probe_downgrades_instead_of_crashing(monkeypatch):
+    """BUCKETEER_CXD_PALLAS=1 on a backend whose plugin cannot compile
+    Pallas kernels must pick the jnp implementation, log once, and bump
+    the metrics counter — never crash at first dispatch (the
+    BENCH_r02/r05 axon failure mode)."""
+    from bucketeer_tpu.codec.pallas import support
+
+    monkeypatch.setenv("BUCKETEER_CXD_PALLAS", "1")
+    monkeypatch.setattr(support, "_PROBE", None)
+    monkeypatch.setattr(support, "_NOTED", set())
+    monkeypatch.setattr(
+        support, "_run_probe",
+        lambda: (False, "RuntimeError: no Mosaic support"))
+    sink = Metrics()
+    monkeypatch.setattr(support, "_SINK", sink)
+    assert cxd._use_pallas() is False
+    fn, donate = cxd.cxd_program(2, 0)      # builds the jnp impl
+    assert donate == ()
+    assert sink.report()["counters"]["encode.pallas_downgrades"] >= 1
+    # And the probe is honest the other way: a passing probe keeps the
+    # kernel selected.
+    monkeypatch.setattr(support, "_PROBE", (True, ""))
+    assert cxd._use_pallas() is True
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="compiled Pallas kernels need a TPU backend")
+def test_compiled_kernels_match_jnp_on_tpu(rng):
+    """Interpret-vs-compiled parity on real hardware: the compiled CX/D
+    and MQ kernels equal the jnp scans bit for bit."""
+    from bucketeer_tpu.codec.pallas.cxd_scan import cxd_pallas
+    from bucketeer_tpu.codec.pallas.mq_scan import mq_pallas
+
+    blocks = np.zeros((2, 64, 64), np.int32)
+    for i in range(2):
+        mags, negs = _random_block(rng, 64, 64, density=0.2)
+        blocks[i] = mags.astype(np.int64) * np.where(negs, -1, 1)
+    nbps = np.array([int(np.abs(blocks[i]).max()).bit_length()
+                     for i in range(2)], np.int32)
+    zeros = np.zeros(2, np.int32)
+    hw = np.full(2, 64, np.int32)
+    xs = jnp.asarray(cxd.scan_xs(P_TEST))
+    jref = jax.vmap(lambda *a: cxd._cxd_single(P_TEST, 0, xs, *a))(
+        jnp.asarray(blocks), jnp.asarray(nbps), jnp.asarray(zeros),
+        jnp.asarray(zeros), jnp.asarray(hw), jnp.asarray(hw))
+    jgot = cxd_pallas(P_TEST, 0, jnp.asarray(blocks), jnp.asarray(nbps),
+                      jnp.asarray(zeros), jnp.asarray(zeros),
+                      jnp.asarray(hw), jnp.asarray(hw))
+    for g, r in zip(jgot, jref):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+    buf, counts = np.asarray(jref[0]), np.asarray(jref[1])
+    totals = np.asarray(jref[4]).astype(np.int32)
+    n_steps = cxd._mq_steps_bucket(int(totals.max()), P_TEST)
+    cap = cxd.mq_capacity(n_steps)
+    flags = np.ones(2, np.int32)
+    margs = (jnp.asarray(buf), jnp.asarray(counts), jnp.asarray(totals),
+             jnp.asarray(flags))
+    mref = jax.vmap(lambda *a: cxd._mq_single(
+        P_TEST, n_steps, cap, *a))(*margs)
+    mgot = mq_pallas(P_TEST, n_steps, cap, *margs)
+    for g, r in zip(mgot, mref):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+@pytest.mark.slow
+def test_device_mq_host_work_reduction(rng):
+    """Throughput smoke on a bench-recipe-shaped encode: the host's
+    Tier-1 share in device-MQ mode (block assembly) must be >= 5x
+    smaller than MQ-replay mode's host share (the ISSUE 9 acceptance
+    bar), and on a real accelerator the device-MQ wall clock must not
+    lose to replay."""
+    import time
+
+    from bucketeer_tpu.codec import cxd as cxd_mod
+
+    img = _photo(rng, 128, 128, comps=3)
+    params = EncodeParams(lossless=False, levels=3, rate=3.0,
+                          n_layers=3, base_delta=2.0)
+
+    def timed_host(mode_params, mod, name):
+        """(re-timed host Tier-1 seconds, encode wall seconds) with the
+        host share captured through the named module seam."""
+        calls = []
+        orig = getattr(mod, name)
+
+        def cap(*args):
+            calls.append(args)
+            return orig(*args)
+
+        encoder.encode_jp2(img, 8, mode_params)     # warm
+        setattr(mod, name, cap)
+        try:
+            t0 = time.perf_counter()
+            encoder.encode_jp2(img, 8, mode_params)
+            wall = time.perf_counter() - t0
+        finally:
+            setattr(mod, name, orig)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for args in calls:
+                orig(*args)
+            best = min(best, time.perf_counter() - t0)
+        return best, wall
+
+    replay_s, replay_wall = timed_host(
+        dataclasses.replace(params, device_cxd=True, device_mq=False),
+        t1_batch, "encode_cxd")
+    mq_s, mq_wall = timed_host(
+        dataclasses.replace(params, device_mq=True),
+        cxd_mod, "assemble_mq_blocks")
+    assert mq_s * 5 <= replay_s, (
+        f"device-MQ host share {mq_s:.4f}s not >=5x below replay's "
+        f"{replay_s:.4f}s")
+    if jax.default_backend() == "tpu":
+        assert mq_wall <= replay_wall * 1.05
+
+
+def _photo(rng, h, w, comps=1):
+    y, x = np.mgrid[0:h, 0:w]
+    base = 120 + 80 * np.sin(x / 17.0) * np.cos(y / 13.0)
+    img = base[..., None] + rng.normal(0, 8, (h, w, comps))
+    img = np.clip(img, 0, 255).astype(np.uint8)
+    return img[..., 0] if comps == 1 else img
