@@ -13,6 +13,14 @@ After an intentional program change, regenerate it with
 ``--write-manifest`` and commit the result — the diff in review *is*
 the compiled-program change.
 
+``--race`` adds the dynamic layer (graftrace): the scheduler scenario
+suite is executed under the controlled scheduler, exploring
+interleavings systematically (bounded preemptions) and by seeded
+random walk within ``--race-budget-s``; data races, lock-inversion
+cycles, deadlocks and broken scenario invariants become findings, each
+carrying the schedule that produced it (``--race-trace-dir`` persists
+the traces, ``--race-replay FILE`` re-executes one bit-for-bit).
+
 Suppression hygiene is always on: a ``# graftlint: disable=`` comment
 or a baseline entry that no longer suppresses any live finding is a
 warning (so ``--strict`` fails on it); ``--prune-baseline`` rewrites
@@ -67,6 +75,38 @@ def main(argv=None) -> int:
                         help="on audit failure, write every lowered "
                              "program's StableHLO here (CI uploads it "
                              "as an artifact)")
+    parser.add_argument("--race", action="store_true",
+                        help="explore scheduler/cache interleavings "
+                             "under the graftrace controlled scheduler "
+                             "and report data races, lock inversions "
+                             "and deadlocks")
+    parser.add_argument("--race-schedules", type=int, default=120,
+                        help="interleavings per scenario (default 120; "
+                             "half systematic DFS, half seeded random)")
+    parser.add_argument("--race-seed", type=int, default=0,
+                        help="base seed for the random-walk schedules "
+                             "(default 0); reruns with the same seed "
+                             "explore byte-identical schedules")
+    parser.add_argument("--race-preemptions", type=int, default=2,
+                        help="preemption bound for the systematic "
+                             "phase (default 2)")
+    parser.add_argument("--race-budget-s", type=float, default=240.0,
+                        help="wall-clock budget for the whole "
+                             "exploration (default 240s; exhaustion is "
+                             "reported, never silent)")
+    parser.add_argument("--race-scenarios", default=None,
+                        help="comma-separated scenario names (default: "
+                             "the non-synthetic suite)")
+    parser.add_argument("--race-trace-dir", default=None,
+                        help="write the failing schedule traces here "
+                             "as JSON (CI uploads them as artifacts)")
+    parser.add_argument("--race-summary-json", default=None,
+                        help="write the exploration summary (counts "
+                             "per scenario, crosscheck) to this file")
+    parser.add_argument("--race-replay", default=None,
+                        help="re-execute one recorded schedule trace "
+                             "file bit-for-bit and report what it "
+                             "finds")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable output")
     args = parser.parse_args(argv)
@@ -87,6 +127,39 @@ def main(argv=None) -> int:
                      else roots[0].parent / DEFAULT_BASELINE)
     manifest_path = (Path(args.manifest) if args.manifest
                      else roots[0].parent / DEFAULT_MANIFEST)
+
+    if args.race_replay:
+        from .graftrace import explore
+
+        try:
+            trace = json.loads(Path(args.race_replay).read_text(
+                encoding="utf-8"))
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"cannot read trace {args.race_replay}: {exc}",
+                  file=sys.stderr)
+            return 2
+        rt = explore.replay_trace(trace)
+        issues = (len(rt.detector.races) + len(rt.deadlocks)
+                  + len(rt.errors))
+        if rt.divergence is not None:
+            # A divergent replay proves nothing either way: the code
+            # under test changed since the trace was recorded. Fail
+            # loudly so a script gating on the exit code never gets a
+            # false green from a stale trace.
+            print(f"replay DIVERGED at decision {rt.divergence}: the "
+                  "code under test no longer follows the recorded "
+                  "schedule — re-explore with --race instead")
+            issues += 1
+        print(f"replayed {trace.get('scenario')} "
+              f"({len(rt.decision_log)} decisions, divergence="
+              f"{rt.divergence}): {len(rt.detector.races)} race(s), "
+              f"{len(rt.deadlocks)} deadlock(s), {len(rt.errors)} "
+              "invariant failure(s)")
+        for race in rt.detector.races:
+            print(f"  race on {race['var']} ({race['kind']})")
+        for name, exc in rt.errors:
+            print(f"  {name}: {type(exc).__name__}: {exc}")
+        return 1 if issues else 0
 
     if args.write_manifest:
         from . import deviceaudit
@@ -131,6 +204,36 @@ def main(argv=None) -> int:
             manifest_path, package_root=roots[0],
             dump_dir=args.dump_dir)
         findings += audit_findings
+
+    if args.race:
+        from .graftrace import explore
+
+        scenario_names = (args.race_scenarios.split(",")
+                          if args.race_scenarios else None)
+        try:
+            race_findings, summary = explore.run_race(
+                roots[0], scenario_names=scenario_names,
+                schedules=args.race_schedules, seed=args.race_seed,
+                preemption_bound=args.race_preemptions,
+                budget_s=args.race_budget_s,
+                trace_dir=args.race_trace_dir)
+        except ValueError as exc:
+            print(str(exc), file=sys.stderr)
+            return 2
+        findings += race_findings
+        if args.race_summary_json:
+            Path(args.race_summary_json).write_text(
+                json.dumps(summary, indent=2) + "\n", encoding="utf-8")
+        if not args.as_json:
+            print(f"graftrace: explored {summary['interleavings']} "
+                  f"interleavings over {len(summary['scenarios'])} "
+                  f"scenario(s) (seed {summary['seed']}, preemption "
+                  f"bound {summary['preemption_bound']}) — "
+                  f"{summary['races']} race(s), "
+                  f"{summary['lock_cycles']} lock cycle(s), "
+                  f"{summary['deadlocks']} deadlock(s), "
+                  f"{summary['invariant_failures']} invariant "
+                  "failure(s)")
 
     if args.as_json:
         print(json.dumps([{

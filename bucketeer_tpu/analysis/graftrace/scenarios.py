@@ -1,0 +1,383 @@
+"""Concurrency scenarios for the serving core.
+
+Each scenario is a deterministic multi-threaded workload over the real
+production objects (EncodeScheduler, the reader's tiered caches, the
+Metrics registry) with the device launch stubbed to a yield-point fake
+— the concurrency *skeleton* is the system under test, so a single
+interleaving costs milliseconds and the explorer can afford hundreds.
+
+Scenario rules:
+
+- all cross-thread synchronization goes through the seam (events,
+  scheduler primitives), never spin-polling — a spin loop under an
+  adversarial schedule is a livelock;
+- invariants must hold in *every* legal interleaving (final-state
+  ledgers, typed-outcome sets, ordering guaranteed by priorities), so
+  an AssertionError is always a bug plus the schedule that exposes it;
+- scenario bodies catch ``Exception``, never ``BaseException`` — the
+  runtime's teardown/deadlock unwinder must pass through.
+
+The two ``synthetic_*`` scenarios carry a seeded data race and a
+seeded lock inversion; they are excluded from the default suite and
+exist so tests (and skeptical users) can watch the detector fire and
+replay the finding from its seed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import seam
+
+SCENARIOS: dict = {}
+
+
+def scenario(name: str, synthetic: bool = False):
+    def wrap(fn):
+        SCENARIOS[name] = {"fn": fn, "synthetic": synthetic,
+                           "doc": (fn.__doc__ or "").strip()}
+        return fn
+    return wrap
+
+
+def default_names() -> list:
+    return [n for n, s in SCENARIOS.items() if not s["synthetic"]]
+
+
+def warm_imports() -> None:
+    """Pre-import the heavy modules scenario threads would otherwise
+    import mid-run (JAX via codec): imports must not happen inside a
+    controlled thread's turn the first time only."""
+    from ...codec import encoder  # noqa: F401
+    from ...codec.decode import t1_dec  # noqa: F401
+    from ...converters import reader  # noqa: F401
+    from ...engine import scheduler  # noqa: F401
+    from ...server import metrics  # noqa: F401
+
+
+class _FakePending:
+    """Quacks like frontend.PendingFrontend for the scheduler's merge
+    path (resolve_stats with a tile window)."""
+
+    def __init__(self, n_tiles: int):
+        self.n_tiles = n_tiles
+
+    def resolve_stats(self, tile_off: int = 0, n_tiles=None):
+        return ("stats", tile_off,
+                self.n_tiles if n_tiles is None else n_tiles)
+
+
+def _stub_launch(plan, tiles, mode="rows"):
+    seam.yield_point("frontend-launch")
+    return _FakePending(len(tiles))
+
+
+def _mk_sched(**kw):
+    from ...engine.scheduler import EncodeScheduler
+    from ...server.metrics import Metrics
+
+    defaults = dict(queue_depth=8, max_concurrent=4, pool_size=1,
+                    window_s=0.005, deadline_s=0.0, retry_after_s=1.0)
+    defaults.update(kw)
+    sched = EncodeScheduler(**defaults)
+    sched.launch_fn = _stub_launch
+    sink = Metrics()
+    sched.set_metrics_sink(sink)
+    return sched, sink
+
+
+@scenario("merged_batch_encode")
+def merged_batch_encode(ctl):
+    """Three concurrent compatible chunks through the device thread:
+    whatever the schedule, every client gets its own windowed result,
+    the batched-tile ledger is exact, and no launch is lost."""
+    from ...engine.scheduler import _SlicedPending
+
+    sched, sink = _mk_sched()
+    plan = ("plan", 4, 4)
+    tiles = np.zeros((1, 4, 4, 3), dtype=np.uint8)
+    results = [None] * 3
+    errors = [None] * 3
+
+    def client(i):
+        # Through submit(), not just dispatch: the slot bookkeeping
+        # (_running writes under _lock) must race the device thread's
+        # window-merge heuristics — the pairing where graftrace caught
+        # the unlocked _running snapshot.
+        try:
+            results[i] = sched.submit(
+                lambda: sched.dispatch_frontend(plan, tiles))
+        # Surfaced through the errors[] invariant assert below.
+        except Exception as exc:  # graftlint: disable=swallowed-exception
+            errors[i] = exc
+
+    threads = [ctl.spawn(lambda i=i: client(i), f"client{i}")
+               for i in range(3)]
+    for t in threads:
+        t.join()
+    sched.close()
+
+    assert errors == [None] * 3, errors
+    for r in results:
+        if isinstance(r, _SlicedPending):
+            assert r.n_tiles == 1 and 0 <= r.tile_off < 3, vars(r)
+        else:
+            assert isinstance(r, _FakePending), r
+    rep = sink.report()
+    counters = rep.get("counters", {})
+    assert counters.get("encode.batched_tiles", 0) == 3, counters
+    assert 1 <= counters.get("encode.device_launches", 0) <= 3, counters
+
+
+@scenario("read_vs_batch_priority")
+def read_vs_batch_priority(ctl):
+    """A read-priority ticket and a batch ticket both queued behind a
+    full slot: the read must be granted first in every schedule."""
+    from ...engine.scheduler import PRIORITY_BATCH
+
+    sched, _ = _mk_sched(max_concurrent=1, window_s=0)
+    release = seam.make_event("scenario.release")
+    started = seam.make_event("scenario.started")
+
+    def blocker():
+        def hold():
+            started.set()
+            release.wait()
+        sched.submit(hold)
+
+    tb = ctl.spawn(blocker, "blocker")
+    started.wait()
+    # Both contenders admitted (deterministically, from the scenario
+    # thread) while the only slot is held.
+    t_batch = sched._admit(PRIORITY_BATCH, None)
+    t_read = sched._admit(-1, None, "decode")
+    order = []
+
+    def waiter(t, tag):
+        sched._await_slot(t)
+        order.append(tag)
+        sched._finish(t)
+
+    w_b = ctl.spawn(lambda: waiter(t_batch, "batch"), "batch")
+    w_r = ctl.spawn(lambda: waiter(t_read, "read"), "read")
+    release.set()
+    tb.join()
+    w_b.join()
+    w_r.join()
+    sched.close()
+    assert order[0] == "read", order
+    assert sched.stats()["admitted"] == 0, sched.stats()
+
+
+@scenario("queuefull_deadline")
+def queuefull_deadline(ctl):
+    """Admission control under contention: a queued request's deadline
+    expires typed on the virtual clock, an over-depth admit gets
+    QueueFull, and the books balance afterwards."""
+    from ...engine.scheduler import (PRIORITY_BATCH, DeadlineExceeded,
+                                     QueueFull)
+
+    sched, sink = _mk_sched(queue_depth=2, max_concurrent=1, window_s=0)
+    release = seam.make_event("scenario.release")
+    started = seam.make_event("scenario.started")
+
+    def blocker():
+        def hold():
+            started.set()
+            release.wait()
+        sched.submit(hold)
+
+    tb = ctl.spawn(blocker, "blocker")
+    started.wait()
+    outcome = {}
+
+    def expiring():
+        try:
+            sched.submit(lambda: None, deadline_s=0.05)
+            outcome["dl"] = "ran"
+        except DeadlineExceeded:
+            outcome["dl"] = "deadline"
+
+    td = ctl.spawn(expiring, "deadline")
+    td.join()
+
+    t_fill = sched._admit(PRIORITY_BATCH, None)   # depth now full
+
+    def overflow():
+        try:
+            sched.submit(lambda: None)
+            outcome["ovf"] = "ran"
+        except QueueFull as exc:
+            assert exc.retry_after > 0
+            outcome["ovf"] = "full"
+
+    to = ctl.spawn(overflow, "overflow")
+    to.join()
+    sched._finish(t_fill)
+    release.set()
+    tb.join()
+    sched.close()
+
+    assert outcome == {"dl": "deadline", "ovf": "full"}, outcome
+    assert sched.stats()["admitted"] == 0, sched.stats()
+    counters = sink.report().get("counters", {})
+    assert counters.get("encode.admission_rejects", 0) == 1, counters
+    assert counters.get("encode.deadline_expired", 0) >= 1, counters
+
+
+@scenario("cache_eviction")
+def cache_eviction(ctl):
+    """Concurrent fills over the tiered read caches: the byte ledger,
+    the budget bound and the eviction count must be exact in every
+    interleaving."""
+    from ...converters.reader import _DecodeCache, _IndexCache
+
+    cache = _DecodeCache(max_bytes=3 * 16)
+
+    def fill(base):
+        for k in range(base, base + 3):
+            cache.put(("k", k), np.zeros(16, np.uint8))
+            cache.get(("k", (k + 1) % 6))
+
+    t0 = ctl.spawn(lambda: fill(0), "fill0")
+    t1 = ctl.spawn(lambda: fill(3), "fill3")
+    t0.join()
+    t1.join()
+    assert cache.nbytes == sum(a.nbytes
+                               for a in cache._entries.values())
+    assert cache.nbytes <= cache.max_bytes
+    assert len(cache) + cache.evictions == 6, \
+        (len(cache), cache.evictions)
+
+    idx = _IndexCache(max_entries=2)
+
+    def ifill(base):
+        for k in range(base, base + 3):
+            idx.put(("i", k), object())
+            idx.get(("i", base))
+
+    t2 = ctl.spawn(lambda: ifill(0), "ifill0")
+    t3 = ctl.spawn(lambda: ifill(3), "ifill3")
+    t2.join()
+    t3.join()
+    assert len(idx) <= 2
+    assert len(idx) + idx.evictions == 6, (len(idx), idx.evictions)
+
+
+@scenario("shutdown_drain")
+def shutdown_drain(ctl):
+    """close() racing an in-flight device dispatch and a queued decode
+    request: everything completes or fails *typed* (SchedulerClosed),
+    in every schedule — a hang here is a deadlock report, not a stuck
+    CI job. Post-close submissions are rejected typed and must not
+    resurrect the device thread."""
+    from ...engine.scheduler import SchedulerClosed
+
+    sched, _ = _mk_sched(max_concurrent=1, window_s=0)
+    started = seam.make_event("scenario.inflight")
+    release = seam.make_event("scenario.release")
+    outcome = {}
+
+    def inflight():
+        def work():
+            started.set()
+            release.wait()
+            try:
+                r = sched.dispatch_frontend(
+                    ("p", 2, 2), np.zeros((1, 2, 2, 3), np.uint8))
+                outcome["inflight"] = ("completed" if r is not None
+                                       else "empty")
+            except SchedulerClosed:
+                outcome["inflight"] = "closed"
+        try:
+            sched.submit(work)
+        except SchedulerClosed:
+            outcome["inflight"] = "closed-at-submit"
+
+    t1 = ctl.spawn(inflight, "inflight")
+    started.wait()
+
+    def queued():
+        try:
+            sched.submit(lambda: None, kind="decode")
+            outcome["queued"] = "ran"
+        except SchedulerClosed:
+            outcome["queued"] = "closed"
+
+    t2 = ctl.spawn(queued, "queued")
+
+    def closer():
+        release.set()
+        sched.close()
+
+    t3 = ctl.spawn(closer, "closer")
+    t1.join()
+    t2.join()
+    t3.join()
+
+    assert outcome.get("inflight") in ("completed", "closed",
+                                       "closed-at-submit"), outcome
+    assert outcome.get("queued") in ("ran", "closed"), outcome
+    try:
+        sched.submit(lambda: None)
+        post = "ran"
+    except SchedulerClosed:
+        post = "closed"
+    assert post == "closed", "submit after close() must be typed-rejected"
+    dt = sched._device_thread
+    assert dt is None or not dt.is_alive(), \
+        "device thread resurrected after close()"
+
+
+@scenario("synthetic_race", synthetic=True)
+def synthetic_race(ctl):
+    """Seeded bug: one writer takes the lock, the other does not — a
+    guaranteed happens-before race the detector must flag on the very
+    first schedule and reproduce bit-for-bit from the seed."""
+    class Counter:
+        def __init__(self):
+            self._lock = seam.make_lock("SyntheticCounter._lock")
+            self.value = 0
+
+        def safe_bump(self):
+            with self._lock:
+                seam.write(self, "value")
+                self.value += 1
+
+        def racy_bump(self):
+            seam.write(self, "value")
+            # The seeded bug. Written via setattr so the *static*
+            # unguarded-write rule cannot see it — exactly the class
+            # of bug that needs a dynamic detector (and the repo-clean
+            # rules_locks gate stays meaningful).
+            setattr(self, "value", self.value + 1)
+
+    c = Counter()
+    t1 = ctl.spawn(c.safe_bump, "safe")
+    t2 = ctl.spawn(c.racy_bump, "racy")
+    t1.join()
+    t2.join()
+
+
+@scenario("synthetic_inversion", synthetic=True)
+def synthetic_inversion(ctl):
+    """Seeded bug: AB/BA lock nesting across two threads. Some
+    schedules actually deadlock (reported with both stacks); every
+    schedule records both graph edges, so the cycle is flagged even
+    when the run happens to survive."""
+    a = seam.make_lock("SyntheticA")
+    b = seam.make_lock("SyntheticB")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = ctl.spawn(ab, "ab")
+    t2 = ctl.spawn(ba, "ba")
+    t1.join()
+    t2.join()

@@ -236,7 +236,7 @@ def run_lint(root: Path, baseline: set | None = None,
     baseline to report (and ``--prune-baseline`` to drop) stale
     entries."""
     from . import abi, rules_async, rules_donation, rules_hygiene, \
-        rules_jax, rules_locks
+        rules_jax, rules_lockorder, rules_locks
 
     project = load_project(Path(root))
     findings: list = []
@@ -251,6 +251,7 @@ def run_lint(root: Path, baseline: set | None = None,
     findings += rules_async.run(project)
     findings += rules_donation.run(project)
     findings += rules_locks.run(project)
+    findings += rules_lockorder.run(project)
     if native_dir is None:
         candidate = Path(root) / "native"
         native_dir = candidate if candidate.is_dir() else None

@@ -46,7 +46,11 @@ from .findings import ERROR, Finding
 
 UNGUARDED_WRITE = "unguarded-field-write"
 
-LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+# threading factories plus the graftrace seam's traced drop-ins
+# (analysis/graftrace/seam.py) — the serving core creates its locks
+# through the seam, and the inference must see through it.
+LOCK_FACTORIES = {"Lock", "RLock", "Condition",
+                  "make_lock", "make_rlock", "make_condition"}
 CONSTRUCTORS = {"__init__", "__post_init__", "__new__"}
 # Container methods that mutate their receiver in place.
 MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
@@ -68,6 +72,16 @@ def _is_lock_factory(node) -> bool:
             and _leaf_name(node.func) in LOCK_FACTORIES)
 
 
+def _factory_ref(node) -> bool:
+    """True for a default_factory value that builds a lock: a bare
+    factory reference (``threading.Lock``) or the zero-arg-lambda idiom
+    the seam needs for named locks
+    (``lambda: seam.make_lock("Metrics._lock")``)."""
+    if _leaf_name(node) in LOCK_FACTORIES:
+        return True
+    return isinstance(node, ast.Lambda) and _is_lock_factory(node.body)
+
+
 def _self_attr(node, self_name: str):
     """The attribute name when ``node`` is ``<self>.<attr>``."""
     if isinstance(node, ast.Attribute) and \
@@ -87,8 +101,7 @@ def _lock_fields(cls: ast.ClassDef) -> set:
             if _is_lock_factory(stmt.value):
                 locks.add(stmt.target.id)
             for kw in stmt.value.keywords:
-                if kw.arg == "default_factory" and \
-                        _leaf_name(kw.value) in LOCK_FACTORIES:
+                if kw.arg == "default_factory" and _factory_ref(kw.value):
                     locks.add(stmt.target.id)
         if isinstance(stmt, ast.Assign) and _is_lock_factory(stmt.value):
             for t in stmt.targets:
@@ -253,11 +266,15 @@ class _MethodWalk:
                 self.stmt(s, locked, lock)
 
 
-def _check_class(mod, cls: ast.ClassDef) -> list:
+def class_accesses(cls: ast.ClassDef):
+    """(lock fields, {attr: [access records]}) for one class. Shared
+    between the unguarded-write check below and graftrace's
+    static/dynamic cross-check (analysis/graftrace/explore.py), so the
+    two analyses reason from the same inference."""
     locks = _lock_fields(cls)
-    if not locks:
-        return []
     accesses: dict = {}
+    if not locks:
+        return locks, accesses
     for meth in cls.body:
         if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
@@ -269,6 +286,13 @@ def _check_class(mod, cls: ast.ClassDef) -> list:
         walk = _MethodWalk(self_name, locks, meth.name, accesses)
         for stmt in meth.body:
             walk.stmt(stmt, False, None)
+    return locks, accesses
+
+
+def _check_class(mod, cls: ast.ClassDef) -> list:
+    locks, accesses = class_accesses(cls)
+    if not locks:
+        return []
 
     findings = []
     for attr, accs in sorted(accesses.items()):

@@ -71,6 +71,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..analysis.graftrace import seam
+
 LOG = logging.getLogger(__name__)
 
 PRIORITY_READ = -1       # interactive tile/region reads outrank encodes
@@ -99,20 +101,30 @@ class DeadlineExceeded(RuntimeError):
     """The request's deadline expired before (or while) encoding."""
 
 
+class SchedulerClosed(RuntimeError):
+    """The scheduler was shut down. New submissions are rejected with
+    this, and work still queued (slot waiters, undisposed device jobs)
+    at close() time fails with it instead of hanging — graftrace's
+    shutdown_drain scenario proved the old close() left slot waiters
+    parked forever on their grant event."""
+
+
 @dataclass
 class _Ticket:
     """One admitted request's place in the slot queue."""
     priority: int
     seq: int
-    deadline: float | None            # absolute time.monotonic()
+    deadline: float | None            # absolute monotonic (seam clock)
     kind: str = "encode"              # metric namespace: encode | decode
-    granted: threading.Event = field(default_factory=threading.Event)
+    granted: threading.Event = field(
+        default_factory=lambda: seam.make_event("Ticket.granted"))
     abandoned: bool = False           # expired while waiting
     closed: bool = False
+    cancelled: bool = False           # close() cancelled it while queued
 
     def expired(self) -> bool:
         return (self.deadline is not None
-                and time.monotonic() > self.deadline)
+                and seam.monotonic() > self.deadline)
 
 
 @dataclass
@@ -122,7 +134,8 @@ class _DeviceJob:
     tiles: np.ndarray
     mode: str
     n_tiles: int
-    event: threading.Event = field(default_factory=threading.Event)
+    event: threading.Event = field(
+        default_factory=lambda: seam.make_event("DeviceJob.event"))
     result: object = None
     error: BaseException | None = None
 
@@ -212,17 +225,21 @@ class EncodeScheduler:
 
         self._pool = ThreadPoolExecutor(max_workers=max(1, self.pool_size),
                                         thread_name_prefix="sched-t1")
-        self._lock = threading.Lock()
+        self._lock = seam.make_lock("EncodeScheduler._lock")
         self._seq = itertools.count()
         self._waiting: list = []      # heap of (priority, seq, ticket)
         self._running = 0
         self._admitted = 0            # waiting + running
+        self._closed = False          # admission-side close flag
         self._sink = None
 
-        self._dq_cv = threading.Condition()
+        self._dq_cv = seam.make_condition("EncodeScheduler._dq_cv")
         self._djobs: deque = deque()
-        self._device_thread: threading.Thread | None = None
-        self._stop = False
+        self._device_thread = None    # threading.Thread-like handle
+        self._stop = False            # device-side close flag
+        # Test/graftrace seam: overrides codec.frontend.dispatch_frontend
+        # so scenarios can explore the batching skeleton without JAX.
+        self.launch_fn = None
 
     # -- metrics ------------------------------------------------------
 
@@ -274,28 +291,38 @@ class EncodeScheduler:
     def _admit(self, priority: int, deadline_s: float | None,
                kind: str = "encode") -> _Ticket:
         with self._lock:
+            seam.read(self, "_closed")
+            if self._closed:
+                raise SchedulerClosed(
+                    f"{kind} rejected: scheduler is closed")
+            seam.read(self, "_admitted")
             if self._admitted >= self.queue_depth:
                 self._count(f"{kind}.admission_rejects")
                 raise QueueFull(self.queue_depth, self.retry_after_s,
                                 kind)
+            seam.write(self, "_admitted")
             self._admitted += 1
             if deadline_s is None:
                 deadline_s = self.default_deadline_s
-            deadline = (time.monotonic() + deadline_s
+            deadline = (seam.monotonic() + deadline_s
                         if deadline_s else None)
             t = _Ticket(priority, next(self._seq), deadline, kind)
             if self._running < self.max_concurrent and not self._waiting:
+                seam.write(self, "_running")
                 self._running += 1
                 t.granted.set()
             else:
+                seam.write(self, "_waiting")
                 heapq.heappush(self._waiting, (priority, t.seq, t))
             return t
 
     def _grant_next_locked(self) -> None:
         while self._waiting and self._running < self.max_concurrent:
+            seam.write(self, "_waiting")
             _, _, t = heapq.heappop(self._waiting)
-            if t.abandoned or t.closed:
+            if t.abandoned or t.closed or t.cancelled:
                 continue
+            seam.write(self, "_running")
             self._running += 1
             t.granted.set()
 
@@ -304,7 +331,7 @@ class EncodeScheduler:
         while not t.granted.is_set():
             timeout = None
             if t.deadline is not None:
-                timeout = t.deadline - time.monotonic()
+                timeout = t.deadline - seam.monotonic()
                 if timeout <= 0:
                     with self._lock:
                         t.abandoned = True
@@ -312,6 +339,12 @@ class EncodeScheduler:
                     raise DeadlineExceeded(
                         f"{t.kind} deadline expired while queued")
             t.granted.wait(timeout)
+        seam.read(t, "cancelled")
+        if t.cancelled:
+            # close() woke us to fail typed, not to run.
+            raise SchedulerClosed(
+                f"{t.kind} request cancelled: scheduler closed while "
+                "it was queued")
         if self._sink is not None:
             self._sink.record(f"{t.kind}.queue_wait",
                               time.perf_counter() - t0)
@@ -321,8 +354,12 @@ class EncodeScheduler:
             if t.closed:
                 return
             t.closed = True
+            seam.write(self, "_admitted")
             self._admitted -= 1
-            if t.granted.is_set():
+            # A cancelled ticket was granted only to deliver the typed
+            # close error — it never occupied a running slot.
+            if t.granted.is_set() and not t.cancelled:
+                seam.write(self, "_running")
                 self._running -= 1
                 self._grant_next_locked()
 
@@ -340,7 +377,9 @@ class EncodeScheduler:
         code-blocks (t1_dec.decode_services) instead of the encode
         pipeline seam.
         Raises :class:`QueueFull` without blocking when the bounded
-        queue is at depth."""
+        queue is at depth, and :class:`SchedulerClosed` once
+        :meth:`close` has run (including for requests that were queued
+        when it ran — never a hang)."""
         from ..codec import encoder as encoder_mod
 
         ticket = self._admit(priority, deadline_s, kind)
@@ -403,28 +442,40 @@ class EncodeScheduler:
         and block until the device thread has dispatched it (the
         launch itself stays async — JAX returns before the program
         finishes). Compatible queued chunks are merged into one
-        launch; the caller gets its slice."""
+        launch; the caller gets its slice. Raises
+        :class:`SchedulerClosed` (never hangs) once :meth:`close` has
+        run."""
         self._ensure_device_thread()
         job = _DeviceJob(plan, np.asarray(tiles), mode, len(tiles))
         with self._dq_cv:
+            seam.read(self, "_stop")
             if self._stop:
-                raise RuntimeError("scheduler is closed")
+                raise SchedulerClosed("scheduler is closed")
+            seam.write(self, "_djobs")
             self._djobs.append(job)
             self._dq_cv.notify_all()
         job.event.wait()
+        seam.read(job, "error")
         if job.error is not None:
             raise job.error
+        seam.read(job, "result")
         return job.result
 
     def _ensure_device_thread(self) -> None:
         with self._dq_cv:
+            seam.read(self, "_stop")
+            if self._stop:
+                # close() is permanent. The old code reset _stop and
+                # restarted the thread here, so a submit racing close()
+                # resurrected a half-alive scheduler (found by the
+                # graftrace shutdown_drain scenario).
+                raise SchedulerClosed("scheduler is closed")
+            seam.read(self, "_device_thread")
             if self._device_thread is None or \
                     not self._device_thread.is_alive():
-                self._stop = False
-                self._device_thread = threading.Thread(
-                    target=self._device_loop, name="sched-device",
-                    daemon=True)
-                self._device_thread.start()
+                seam.write(self, "_device_thread")
+                self._device_thread = seam.start_thread(
+                    self._device_loop, name="sched-device")
 
     def _take_compatible_locked(self, group: list) -> int:
         """Move queued jobs merge-compatible with group[0] into the
@@ -436,6 +487,7 @@ class EncodeScheduler:
         total = sum(j.n_tiles for j in group)
         kept: deque = deque()
         while self._djobs:
+            seam.write(self, "_djobs")
             j = self._djobs.popleft()
             if j.mode == "rows" and j.key == key and \
                     total + j.n_tiles <= _MAX_BATCH_TILES:
@@ -443,29 +495,48 @@ class EncodeScheduler:
                 total += j.n_tiles
             else:
                 kept.append(j)
+        seam.write(self, "_djobs")
         self._djobs = kept
         return total
+
+    def _running_count(self) -> int:
+        """Granted-slot snapshot for the device thread's merge
+        heuristics. graftrace flagged the old bare ``self._running``
+        read here as a data race (every write happens under ``_lock``;
+        the device loop read it under ``_dq_cv`` only), so the snapshot
+        takes the lock — _dq_cv -> _lock nests nowhere in the reverse
+        order (the lock-order-cycle rule keeps it that way)."""
+        with self._lock:
+            seam.read(self, "_running")
+            return self._running
 
     def _device_loop(self) -> None:
         while True:
             with self._dq_cv:
                 while not self._djobs and not self._stop:
                     self._dq_cv.wait()
+                seam.read(self, "_stop")
                 if self._stop:
                     for j in self._djobs:
-                        j.error = RuntimeError("scheduler closed")
+                        seam.write(j, "error")
+                        j.error = SchedulerClosed(
+                            "scheduler closed before this chunk's "
+                            "device launch")
                         j.event.set()
+                    seam.write(self, "_djobs")
                     self._djobs.clear()
                     return
+                seam.write(self, "_djobs")
                 group = [self._djobs.popleft()]
                 if group[0].mode == "rows" and self.window_s > 0:
                     # Continuous batching: wait up to the window for
                     # co-batchable chunks while other running requests
                     # could still contribute one.
-                    limit = time.monotonic() + self.window_s
+                    limit = seam.monotonic() + self.window_s
                     while True:
                         total = self._take_compatible_locked(group)
-                        if (len(group) >= max(1, self._running)
+                        running = self._running_count()
+                        if (len(group) >= max(1, running)
                                 or total >= _MAX_BATCH_TILES):
                             break
                         # Futile-wait cut: if every other running
@@ -475,9 +546,9 @@ class EncodeScheduler:
                         # arrive — launch now instead of burning the
                         # window on their critical path.
                         if self._djobs and len(self._djobs) >= \
-                                self._running - len(group):
+                                running - len(group):
                             break
-                        remaining = limit - time.monotonic()
+                        remaining = limit - seam.monotonic()
                         if remaining <= 0:
                             break
                         self._dq_cv.wait(remaining)
@@ -498,18 +569,23 @@ class EncodeScheduler:
                         j.event.set()
 
     def _launch(self, group: list) -> None:
-        from ..codec import frontend
+        launch = self.launch_fn
+        if launch is None:
+            from ..codec import frontend
+            launch = frontend.dispatch_frontend
 
         try:
             if len(group) == 1:
-                group[0].result = frontend.dispatch_frontend(
+                result = launch(
                     group[0].plan, group[0].tiles, mode=group[0].mode)
+                seam.write(group[0], "result")
+                group[0].result = result
             else:
                 tiles = np.concatenate([j.tiles for j in group])
-                merged = frontend.dispatch_frontend(
-                    group[0].plan, tiles, mode="rows")
+                merged = launch(group[0].plan, tiles, mode="rows")
                 off = 0
                 for j in group:
+                    seam.write(j, "result")
                     j.result = _SlicedPending(merged, off, j.n_tiles)
                     off += j.n_tiles
         # The whole group shares the failed launch; the error is
@@ -517,6 +593,7 @@ class EncodeScheduler:
         # waiter hangs and nothing is swallowed.
         except Exception as exc:    # graftlint: disable=swallowed-exception
             for j in group:
+                seam.write(j, "error")
                 j.error = exc
         finally:
             if self._sink is not None:
@@ -530,23 +607,56 @@ class EncodeScheduler:
     # -- lifecycle -----------------------------------------------------
 
     def close(self) -> None:
-        """Stop the device thread and the host pool (tests / embedders;
-        the process-wide instance lives for the process)."""
+        """Shut down, permanently: stop admission, cancel queued slot
+        waiters *typed* (:class:`SchedulerClosed`), let the in-flight
+        device group finish, drain still-queued device jobs typed,
+        then stop the device thread and the host pool.
+
+        The cancellation pass exists because graftrace's
+        shutdown_drain scenario deadlocked the old close(): a request
+        waiting for a slot parked on ``granted.wait()`` forever, since
+        nothing ever granted or woke it after shutdown."""
+        with self._lock:
+            seam.write(self, "_closed")
+            self._closed = True
+            seam.write(self, "_waiting")
+            while self._waiting:
+                _, _, t = heapq.heappop(self._waiting)
+                if not t.closed and not t.granted.is_set():
+                    seam.write(t, "cancelled")
+                    t.cancelled = True
+                    t.granted.set()
         with self._dq_cv:
+            seam.write(self, "_stop")
             self._stop = True
             self._dq_cv.notify_all()
-        if self._device_thread is not None:
-            self._device_thread.join(timeout=5)
-        self._pool.shutdown(wait=True)
+            seam.read(self, "_device_thread")
+            device_thread = self._device_thread
+        if device_thread is not None:
+            device_thread.join(timeout=5)
+        with self._lock:
+            seam.read(self, "_admitted")
+            busy = self._admitted > 0
+        if not busy:
+            self._pool.shutdown(wait=True)
+        # else: granted in-flight requests still own the pool — a
+        # shutdown under them turns their next Tier-1 chunk into an
+        # untyped "cannot schedule new futures" RuntimeError, breaking
+        # the completes-or-fails-typed contract. Leave it; its idle
+        # threads wind down at interpreter exit (the same policy as
+        # configure()'s pool swap).
 
     def stats(self) -> dict:
         with self._lock:
+            seam.read(self, "_running")
+            seam.read(self, "_admitted")
             return {"running": self._running,
                     "waiting": len(self._waiting),
                     "admitted": self._admitted,
                     "queue_depth": self.queue_depth,
                     "max_concurrent": self.max_concurrent,
-                    "pool_size": self.pool_size}
+                    "pool_size": self.pool_size,
+                    "closed": self._closed}
 
 
 # The class predates decode routing; the neutral name is the current

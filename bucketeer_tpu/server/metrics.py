@@ -11,6 +11,8 @@ import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 
+from ..analysis.graftrace import seam
+
 
 @dataclass
 class StageStats:
@@ -93,9 +95,11 @@ class Metrics:
     # and += on the stat fields is a read-modify-write — serialize every
     # update or rare-event counters silently lose increments. The
     # single _lock covers stages, overlaps, counters and values; the
-    # hammer test (tests/test_metrics.py) races all four.
-    _lock: threading.Lock = field(default_factory=threading.Lock,
-                                  repr=False)
+    # hammer test (tests/test_metrics.py) races all four, and the
+    # graftrace seam lets the race explorer serialize + check them.
+    _lock: threading.Lock = field(
+        default_factory=lambda: seam.make_lock("Metrics._lock"),
+        repr=False)
 
     @contextlib.contextmanager
     def time(self, stage: str, pixels: int = 0):
@@ -108,6 +112,7 @@ class Metrics:
     def record(self, stage: str, seconds: float, pixels: int = 0,
                items: int = 0) -> None:
         with self._lock:
+            seam.write(self, "stages")
             self.stages[stage].record(seconds, pixels, items)
 
     def record_overlap(self, stage: str, device_s: float, host_s: float,
@@ -115,22 +120,29 @@ class Metrics:
         """Record one pipelined run's device-dispatch vs host-coding
         segments (codec/encoder.py overlapped pipeline)."""
         with self._lock:
+            seam.write(self, "overlaps")
             self.overlaps[stage].record(device_s, host_s, wall_s, pixels)
 
     def count(self, name: str, n: int = 1) -> None:
         """Bump an event counter (PCRD floor re-runs, Tier-2 rebuild
         iterations, mesh routings, admission rejects, ...)."""
         with self._lock:
+            seam.write(self, "counters")
             self.counters[name] += n
 
     def observe(self, name: str, value: float) -> None:
         """Record one sample of a value distribution (e.g. the encode
         scheduler's per-launch batch occupancy)."""
         with self._lock:
+            seam.write(self, "values")
             self.values[name].observe(float(value))
 
     def report(self) -> dict:
         with self._lock:
+            seam.read(self, "stages")
+            seam.read(self, "overlaps")
+            seam.read(self, "counters")
+            seam.read(self, "values")
             return self._report_locked()
 
     def _report_locked(self) -> dict:

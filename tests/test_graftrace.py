@@ -1,0 +1,285 @@
+"""graftrace runtime + detector mechanics: serialized deterministic
+execution, happens-before edges (locks, events, fork/join, condition),
+race detection with both stacks, dynamic lock-order cycles, deadlock
+reporting instead of hangs, virtual-clock timeouts, and bit-for-bit
+replay of the seeded synthetic bugs."""
+import pytest
+
+from bucketeer_tpu.analysis.graftrace import explore, seam
+from bucketeer_tpu.analysis.graftrace.explore import run_schedule
+from bucketeer_tpu.analysis.graftrace.runtime import (GuidedStrategy,
+                                                      RandomStrategy)
+
+PKG = "bucketeer_tpu"
+
+
+class Box:
+    def __init__(self):
+        self.value = 0
+
+
+# --- serialization + determinism ---------------------------------------
+
+def _bump_scenario(ctl, sync: bool):
+    lock = seam.make_lock("Box._lock")
+    box = Box()
+
+    def bump():
+        if sync:
+            with lock:
+                seam.write(box, "value")
+                box.value += 1
+        else:
+            seam.write(box, "value")
+            box.value += 1
+
+    threads = [ctl.spawn(bump, f"bump{i}") for i in range(3)]
+    for t in threads:
+        t.join()
+    return box
+
+
+def test_run_is_deterministic_for_a_seed():
+    runs = [run_schedule(lambda ctl: _bump_scenario(ctl, True),
+                         RandomStrategy(7)) for _ in range(2)]
+    logs = [[d["chosen"] for d in rt.decision_log] for rt in runs]
+    assert logs[0] == logs[1]
+    assert len(logs[0]) > 10                 # it actually scheduled
+    assert all(not rt.detector.races for rt in runs)
+
+
+def test_different_seeds_explore_different_schedules():
+    logs = set()
+    for seed in range(6):
+        rt = run_schedule(lambda ctl: _bump_scenario(ctl, True),
+                          RandomStrategy(seed))
+        logs.add(tuple(d["chosen"] for d in rt.decision_log))
+    assert len(logs) > 1
+
+
+def test_unlocked_writes_race_with_both_stacks():
+    rt = run_schedule(lambda ctl: _bump_scenario(ctl, False),
+                      RandomStrategy(0))
+    assert rt.detector.races
+    race = rt.detector.races[0]
+    assert race["var"] == "Box.value"
+    # Both sides carry a stack into this test file.
+    assert any("test_graftrace" in f for f, _, _ in race["a"]["stack"])
+    assert any("test_graftrace" in f for f, _, _ in race["b"]["stack"])
+
+
+def test_lock_ordered_writes_are_clean_across_seeds():
+    for seed in range(8):
+        rt = run_schedule(lambda ctl: _bump_scenario(ctl, True),
+                          RandomStrategy(seed))
+        assert rt.detector.races == [], (seed, rt.detector.races)
+
+
+# --- happens-before edges ----------------------------------------------
+
+def test_event_set_wait_orders_accesses():
+    def scn(ctl):
+        box = Box()
+        ev = seam.make_event("ready")
+
+        def writer():
+            seam.write(box, "value")
+            box.value = 42
+            ev.set()
+
+        def reader():
+            ev.wait()
+            seam.read(box, "value")
+            assert box.value == 42
+
+        t1 = ctl.spawn(writer, "writer")
+        t2 = ctl.spawn(reader, "reader")
+        t1.join()
+        t2.join()
+
+    for seed in range(8):
+        rt = run_schedule(scn, RandomStrategy(seed))
+        assert rt.detector.races == [], (seed, rt.detector.races)
+        assert rt.errors == []
+
+
+def test_fork_join_orders_accesses():
+    def scn(ctl):
+        box = Box()
+        seam.write(box, "value")
+        box.value = 1                      # before fork: ordered
+
+        def child():
+            seam.write(box, "value")
+            box.value = 2
+
+        t = ctl.spawn(child, "child")
+        t.join()
+        seam.read(box, "value")            # after join: ordered
+        assert box.value == 2
+
+    for seed in range(6):
+        rt = run_schedule(scn, RandomStrategy(seed))
+        assert rt.detector.races == [], (seed, rt.detector.races)
+        assert rt.errors == []
+
+
+def test_condition_wait_notify_roundtrip():
+    def scn(ctl):
+        cv = seam.make_condition("cv")
+        box = Box()
+
+        def producer():
+            with cv:
+                seam.write(box, "value")
+                box.value = 7
+                cv.notify_all()
+
+        def consumer():
+            with cv:
+                while box.value == 0:
+                    if not cv.wait(timeout=1.0):
+                        break
+                seam.read(box, "value")
+                assert box.value == 7
+
+        t2 = ctl.spawn(consumer, "consumer")
+        t1 = ctl.spawn(producer, "producer")
+        t1.join()
+        t2.join()
+
+    for seed in range(6):
+        rt = run_schedule(scn, RandomStrategy(seed))
+        assert rt.detector.races == [], (seed, rt.detector.races)
+        assert rt.errors == [], (seed, rt.errors)
+
+
+# --- deadlocks + virtual clock -----------------------------------------
+
+def test_self_deadlock_is_reported_not_hung():
+    def scn(ctl):
+        lk = seam.make_lock("SelfLock")
+
+        def t():
+            with lk:
+                lk.acquire()               # guaranteed self-deadlock
+
+        th = ctl.spawn(t, "t")
+        th.join()
+
+    rt = run_schedule(scn, RandomStrategy(0))
+    assert len(rt.deadlocks) == 1
+    report = rt.deadlocks[0]
+    assert any("lock:SelfLock" in waiting
+               for _, waiting, _, _ in report)
+
+
+def test_ab_ba_deadlock_found_and_deterministic():
+    def scn(ctl):
+        a = seam.make_lock("A")
+        b = seam.make_lock("B")
+
+        def ab():
+            with a:
+                seam.yield_point("mid")
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                seam.yield_point("mid")
+                with a:
+                    pass
+
+        t1 = ctl.spawn(ab, "ab")
+        t2 = ctl.spawn(ba, "ba")
+        t1.join()
+        t2.join()
+
+    hits = [seed for seed in range(20)
+            if run_schedule(scn, RandomStrategy(seed)).deadlocks]
+    assert hits, "no seed drove the AB/BA interleaving into deadlock"
+    # Same seeds -> same verdicts.
+    rehits = [seed for seed in range(20)
+              if run_schedule(scn, RandomStrategy(seed)).deadlocks]
+    assert hits == rehits
+
+
+def test_timed_wait_uses_the_virtual_clock():
+    seen = {}
+
+    def scn(ctl):
+        ev = seam.make_event("never")
+        t0 = seam.monotonic()
+        assert ev.wait(timeout=3.0) is False
+        seen["elapsed"] = seam.monotonic() - t0
+
+    rt = run_schedule(scn, RandomStrategy(0))
+    assert rt.errors == []
+    assert seen["elapsed"] >= 3.0           # virtual, not wall clock
+
+
+# --- guided replay -----------------------------------------------------
+
+def test_guided_prefix_forces_a_schedule_and_replays():
+    def scn(ctl):
+        _bump_scenario(ctl, False)
+
+    base = run_schedule(scn, RandomStrategy(3))
+    decisions = [d["chosen"] for d in base.decision_log]
+    replay = run_schedule(scn, GuidedStrategy(decisions))
+    assert [d["chosen"] for d in replay.decision_log] == decisions
+    assert replay.divergence is None
+    assert replay.detector.races == base.detector.races
+
+
+# --- the seeded synthetic bugs (acceptance) -----------------------------
+
+def _explore_synthetic(name, seed):
+    return explore.run_race(PKG, scenario_names=[name], schedules=6,
+                            seed=seed, budget_s=120)
+
+
+def test_synthetic_race_detected_and_replays_from_seed():
+    f1, s1 = _explore_synthetic("synthetic_race", seed=11)
+    f2, s2 = _explore_synthetic("synthetic_race", seed=11)
+    assert s1["races"] == 1
+    races = [f for f in f1 if f.rule == explore.DYNAMIC_RACE]
+    assert len(races) == 1
+    assert "Counter.value" in races[0].message
+    # Bit-for-bit identical report on re-exploration from the seed.
+    assert [f.render() for f in f1] == [f.render() for f in f2]
+    assert s1 == s2
+    # The static rule cannot see this write; the cross-check says so.
+    assert any(f.rule == explore.RACE_LINT_MISMATCH for f in f1)
+
+
+def test_synthetic_inversion_detected_and_replays_from_seed():
+    f1, s1 = _explore_synthetic("synthetic_inversion", seed=5)
+    f2, _ = _explore_synthetic("synthetic_inversion", seed=5)
+    assert s1["lock_cycles"] == 1
+    inv = [f for f in f1 if f.rule == explore.LOCK_INVERSION]
+    assert len(inv) == 1
+    assert "SyntheticA" in inv[0].message
+    assert "SyntheticB" in inv[0].message
+    assert [f.render() for f in f1] == [f.render() for f in f2]
+
+
+def test_replay_trace_reproduces_the_synthetic_race(tmp_path):
+    f1, _ = explore.run_race(
+        PKG, scenario_names=["synthetic_race"], schedules=4, seed=2,
+        budget_s=120, trace_dir=tmp_path)
+    traces = sorted(tmp_path.glob("synthetic_race-race-*.json"))
+    assert traces, list(tmp_path.iterdir())
+    import json
+    trace = json.loads(traces[0].read_text())
+    rt = explore.replay_trace(trace)
+    assert rt.divergence is None
+    assert len(rt.detector.races) == 1
+    assert rt.detector.races[0]["var"] == "Counter.value"
+
+
+def test_unknown_scenario_is_a_loud_error():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        explore.run_race(PKG, scenario_names=["nope"], schedules=2,
+                         budget_s=10)

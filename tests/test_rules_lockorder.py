@@ -1,0 +1,260 @@
+"""Static lock-order-cycle rule (analysis/rules_lockorder.py): seeded
+AB/BA cycles fire (nested withs, one-hop method calls, module-level
+locks), consistent orders and reentrant self-acquisition stay clean,
+and the repo itself is cycle-free."""
+import textwrap
+from pathlib import Path
+
+from bucketeer_tpu.analysis import lint, rules_lockorder
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(tmp_path, body):
+    root = tmp_path / "pkg"
+    (root / "engine").mkdir(parents=True)
+    (root / "__init__.py").write_text('"""fixture"""\n')
+    (root / "engine" / "__init__.py").write_text('"""fixture"""\n')
+    (root / "engine" / "mod.py").write_text(textwrap.dedent(body),
+                                            encoding="utf-8")
+    return rules_lockorder.run(lint.load_project(root))
+
+
+def test_nested_with_ab_ba_cycle_fires(tmp_path):
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Two:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+    msg = findings[0].message
+    assert "Two._a" in msg and "Two._b" in msg
+    assert "Two.fwd" in msg and "Two.rev" in msg
+
+
+def test_one_hop_method_call_cycle_fires(tmp_path):
+    """The edge hides behind a call: with A held, a method that takes
+    B is invoked — and elsewhere the reverse."""
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Hop:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b:
+                    pass
+
+            def rev(self):
+                with self._b:
+                    self._take_a()
+
+            def _take_a(self):
+                with self._a:
+                    pass
+        """)
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+
+
+def test_consistent_global_order_is_clean(tmp_path):
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Ordered:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b:
+                    pass
+        """)
+    assert findings == []
+
+
+def test_nonreentrant_self_reacquire_fires(tmp_path):
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Oops:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+        """)
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+    assert "self-deadlock" in findings[0].message
+
+
+def test_class_body_assign_lock_is_inferred(tmp_path):
+    """Plain (unannotated) class-attribute lock fields must feed the
+    same inference — rules_locks handles them, so this rule must too."""
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Attr:
+            _lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+        """)
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+    assert "self-deadlock" in findings[0].message
+
+
+def test_reentrant_self_reacquire_is_clean(tmp_path):
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Fine:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._cv = threading.Condition()
+
+            def outer(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+                with self._cv:
+                    with self._cv:
+                        pass
+        """)
+    assert findings == []
+
+
+def test_module_level_lock_cycle_fires(tmp_path):
+    findings = _run(tmp_path, """\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+
+        def fwd():
+            with A:
+                with B:
+                    pass
+
+
+        def rev():
+            with B:
+                with A:
+                    pass
+        """)
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+    assert "pkg/engine/mod.py:A" in findings[0].message
+
+
+def test_seam_factory_locks_are_recognized(tmp_path):
+    findings = _run(tmp_path, """\
+        from bucketeer_tpu.analysis.graftrace import seam
+
+
+        class Traced:
+            def __init__(self):
+                self._a = seam.make_lock("Traced._a")
+                self._b = seam.make_condition("Traced._b")
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert [f.rule for f in findings] == ["lock-order-cycle"]
+
+
+def test_nested_def_does_not_inherit_held_locks(tmp_path):
+    findings = _run(tmp_path, """\
+        import threading
+
+
+        class Box:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    def later():
+                        with self._b:
+                            pass
+                    return later
+
+            def rev(self):
+                with self._b:
+                    pass
+
+            def other(self):
+                with self._b:
+                    self._take_a_free()
+
+            def _take_a_free(self):
+                pass
+        """)
+    assert findings == []
+
+
+# --- the repo gate ------------------------------------------------------
+
+def test_repo_is_cycle_free():
+    project = lint.load_project(REPO / "bucketeer_tpu")
+    findings = rules_lockorder.run(project)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_repo_scheduler_cv_to_lock_edge_is_seen():
+    """The device loop holds _dq_cv and snapshots _running under _lock
+    (the graftrace-driven fix): the rule must see that nesting through
+    the one-hop call, or the repo gate above is vacuous."""
+    project = lint.load_project(REPO / "bucketeer_tpu")
+    edges: dict = {}
+    for mod in project.modules:
+        if mod.relpath.endswith("engine/scheduler.py"):
+            rules_lockorder._collect_edges(mod, edges)
+    assert ("EncodeScheduler._dq_cv", "EncodeScheduler._lock") in edges
+    assert ("EncodeScheduler._lock", "EncodeScheduler._dq_cv") \
+        not in edges

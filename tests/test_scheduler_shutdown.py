@@ -1,0 +1,185 @@
+"""Scheduler shutdown/drain semantics (the graftrace-found fixes, on
+real threads): close() is permanent and typed — queued slot waiters are
+cancelled with SchedulerClosed instead of hanging, the in-flight device
+group completes, queued device jobs drain typed, and nothing can
+resurrect the device thread after close. Plus the pinned-schedule
+graftrace regression sweep for the shutdown_drain scenario."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bucketeer_tpu.engine.scheduler import (EncodeScheduler,
+                                            SchedulerClosed)
+
+JOIN_S = 10   # any hang fails loudly instead of wedging the suite
+
+
+def _sched(**kw):
+    defaults = dict(queue_depth=8, max_concurrent=1, pool_size=1,
+                    window_s=0)
+    defaults.update(kw)
+    return EncodeScheduler(**defaults)
+
+
+def _hold_slot(sched):
+    release, holding = threading.Event(), threading.Event()
+
+    def blocker():
+        def hold():
+            holding.set()
+            release.wait(timeout=JOIN_S)
+        sched.submit(hold)
+
+    t = threading.Thread(target=blocker)
+    t.start()
+    assert holding.wait(timeout=JOIN_S)
+    return t, release
+
+
+def test_submit_after_close_raises_typed():
+    sched = _sched()
+    sched.close()
+    with pytest.raises(SchedulerClosed):
+        sched.submit(lambda: None)
+    with pytest.raises(SchedulerClosed):
+        sched.read(lambda: None)
+    assert sched.stats()["closed"] is True
+
+
+def test_close_cancels_queued_waiter_typed_never_hangs():
+    """The bug graftrace's shutdown_drain scenario exposed: a request
+    waiting for a slot parked on granted.wait() forever because the
+    old close() neither granted nor woke it."""
+    sched = _sched()
+    blocker, release = _hold_slot(sched)
+    errs = []
+    queued_in = threading.Event()
+
+    def queued():
+        queued_in.set()
+        try:
+            sched.submit(lambda: None, kind="decode")
+        except SchedulerClosed as exc:
+            errs.append(exc)
+
+    t = threading.Thread(target=queued)
+    t.start()
+    assert queued_in.wait(timeout=JOIN_S)
+    deadline = time.monotonic() + JOIN_S
+    while sched.stats()["waiting"] < 1:
+        assert time.monotonic() < deadline, "queued request never queued"
+        time.sleep(0.005)
+    sched.close()
+    t.join(timeout=JOIN_S)
+    assert not t.is_alive(), "queued request hung through close()"
+    assert len(errs) == 1 and isinstance(errs[0], SchedulerClosed)
+    release.set()
+    blocker.join(timeout=JOIN_S)
+    assert not blocker.is_alive()
+    assert sched.stats()["admitted"] == 0
+
+
+def test_dispatch_after_close_is_typed_and_never_resurrects():
+    sched = _sched()
+    sched.launch_fn = lambda plan, tiles, mode="rows": "ok"
+    assert sched.dispatch_frontend(
+        ("p",), np.zeros((1, 2, 2, 3), np.uint8)) == "ok"
+    sched.close()
+    with pytest.raises(SchedulerClosed):
+        sched.dispatch_frontend(("p",), np.zeros((1, 2, 2, 3),
+                                                 np.uint8))
+    dt = sched._device_thread
+    assert dt is None or not dt.is_alive(), \
+        "device thread resurrected after close()"
+
+
+def test_inflight_group_completes_and_queued_job_drains_typed():
+    """An in-flight merged batch at close() completes; a device job
+    still queued behind it fails with SchedulerClosed — never hangs."""
+    gate = threading.Event()
+    in_launch = threading.Event()
+
+    def slow_launch(plan, tiles, mode="rows"):
+        in_launch.set()
+        assert gate.wait(timeout=JOIN_S)
+        return "done"
+
+    sched = _sched(max_concurrent=4)
+    sched.launch_fn = slow_launch
+    results, errors = {}, {}
+
+    def client(tag, plan):
+        try:
+            results[tag] = sched.dispatch_frontend(
+                plan, np.zeros((1, 2, 2, 3), np.uint8))
+        except SchedulerClosed as exc:
+            errors[tag] = exc
+
+    # Incompatible plans, so the second job queues behind the first
+    # launch instead of merging into it.
+    t1 = threading.Thread(target=client, args=("inflight", ("p1",)))
+    t1.start()
+    assert in_launch.wait(timeout=JOIN_S)
+    t2 = threading.Thread(target=client, args=("queued", ("p2",)))
+    t2.start()
+    deadline = time.monotonic() + JOIN_S
+    while not sched._djobs:
+        assert time.monotonic() < deadline, "second job never queued"
+        time.sleep(0.005)
+
+    closer = threading.Thread(target=sched.close)
+    closer.start()
+    gate.set()                      # let the in-flight launch finish
+    for t in (t1, t2, closer):
+        t.join(timeout=JOIN_S)
+        assert not t.is_alive(), "shutdown hung"
+    assert results.get("inflight") == "done"
+    assert isinstance(errors.get("queued"), SchedulerClosed)
+
+
+def test_close_is_idempotent():
+    sched = _sched()
+    sched.close()
+    sched.close()
+
+
+def test_close_with_inflight_request_keeps_the_pool_usable():
+    """A granted in-flight request still owns the Tier-1 pool when
+    close() runs: its next chunk's pool.submit must not hit an untyped
+    'cannot schedule new futures' RuntimeError mid-encode."""
+    sched = _sched()
+    blocker, release = _hold_slot(sched)
+    try:
+        sched.close()
+        # The in-flight request's pool survives close().
+        assert sched._pool.submit(lambda: 41 + 1).result(
+            timeout=JOIN_S) == 42
+    finally:
+        release.set()
+        blocker.join(timeout=JOIN_S)
+    assert not blocker.is_alive()
+
+
+def test_close_with_nothing_running_shuts_the_pool():
+    sched = _sched()
+    sched.close()
+    with pytest.raises(RuntimeError):
+        sched._pool.submit(lambda: None)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_graftrace_shutdown_drain_pinned_schedules(seed):
+    """Pinned-schedule regression fixture: the exact exploration that
+    deadlocked the pre-fix close() (and caught the resurrecting device
+    thread) replays clean. Deterministic per seed."""
+    from bucketeer_tpu.analysis.graftrace import explore
+
+    findings, summary = explore.run_race(
+        "bucketeer_tpu", scenario_names=["shutdown_drain"],
+        schedules=24, seed=seed, budget_s=240)
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert summary["deadlocks"] == 0
+    assert summary["invariant_failures"] == 0
+    assert summary["races"] == 0
