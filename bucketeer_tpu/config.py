@@ -82,6 +82,22 @@ SCHED_DEADLINE_S = "bucketeer.sched.deadline.s"
 # (converters/reader.py; 0 disables). Env analog by the standard
 # overlay: BUCKETEER_DECODE_CACHE_MB.
 DECODE_CACHE_MB = "bucketeer.decode.cache.mb"
+# Durable job store (engine/journal.py): when set, the JobStore keeps a
+# write-ahead journal + snapshot in this directory so killed processes
+# resume their batch jobs on restart. Absent/empty keeps the in-memory
+# store (tests, dev). Env analog: BUCKETEER_JOB_JOURNAL_DIR.
+JOB_JOURNAL_DIR = "bucketeer.job.journal.dir"
+# Unified retry policy (engine/retry.py): every engine retry loop (bus
+# requeue, S3 upload, status writes) draws bounded exponential-backoff
+# + full-jitter delays from one policy, and per-address circuit
+# breakers trip open after this many consecutive target failures,
+# half-opening after the reset window. Env analogs by the standard
+# overlay (BUCKETEER_RETRY_MAX_ATTEMPTS, ...).
+RETRY_MAX_ATTEMPTS = "bucketeer.retry.max.attempts"
+RETRY_BASE_DELAY_S = "bucketeer.retry.base.delay.s"
+RETRY_MAX_DELAY_S = "bucketeer.retry.max.delay.s"
+BREAKER_THRESHOLD = "bucketeer.breaker.failure.threshold"
+BREAKER_RESET_S = "bucketeer.breaker.reset.s"
 
 # Every known key (env overlay applies to these even without defaults).
 ALL_KEYS = (
@@ -97,6 +113,8 @@ ALL_KEYS = (
     COMPILE_CACHE,
     SCHED_QUEUE_DEPTH, SCHED_MAX_CONCURRENT, SCHED_POOL_SIZE,
     SCHED_WINDOW_MS, SCHED_DEADLINE_S, DECODE_CACHE_MB,
+    JOB_JOURNAL_DIR, RETRY_MAX_ATTEMPTS, RETRY_BASE_DELAY_S,
+    RETRY_MAX_DELAY_S, BREAKER_THRESHOLD, BREAKER_RESET_S,
 )
 
 _DEFAULTS: dict[str, Any] = {
@@ -111,6 +129,10 @@ _DEFAULTS: dict[str, Any] = {
     TPU_LOSSY_RATE: 3.0,
     TPU_BATCH_SIZE: 8,
     TPU_MESH_SHAPE: "",
+    RETRY_MAX_ATTEMPTS: 32,
+    RETRY_MAX_DELAY_S: 30.0,
+    BREAKER_THRESHOLD: 5,
+    BREAKER_RESET_S: 30.0,
 }
 
 

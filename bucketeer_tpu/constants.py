@@ -28,6 +28,9 @@ NOTHING_PROCESSED = "nothing-processed"
 # Admission backpressure: seconds-to-wait hint carried in a 503 reply
 # body (engine/scheduler.py QueueFull -> HTTP Retry-After header).
 RETRY_AFTER = "retry-after"
+# Per-job dead-letter records in the GET /batch/jobs/{name} detail
+# (engine/retry.py DeadLetterLog — items that exhausted their budget).
+DEAD_LETTERS = "dead-letters"
 BATCH_RESPONSE = "batch-response"
 S3_BUCKET = "bucket"
 

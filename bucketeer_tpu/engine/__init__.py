@@ -5,8 +5,11 @@ src/main/java/edu/ucla/library/bucketeer/verticles/ — see SURVEY.md §1
 L2). Same request/reply + ``retry`` backpressure protocol, same shared
 state semantics, asyncio instead of an event-bus process."""
 from .batch import BATCH_CONVERTER, BatchConverterWorker, start_job
-from .bus import BusError, MessageBus, Reply
+from .bus import BusClosed, BusError, MessageBus, Reply
 from .core import Engine
+from .journal import JobJournal, JournalUnavailable
+from .retry import (BreakerRegistry, CircuitBreaker, DeadLetterLog,
+                    RetryPolicy)
 from .s3 import (FakeS3Client, HttpS3Client, S3_UPLOADER, S3Error,
                  S3UploadWorker, S3UploaderConfig)
 from .scheduler import (PRIORITY_BATCH, PRIORITY_SINGLE, DeadlineExceeded,
@@ -19,8 +22,10 @@ from .workers import (FESTER, FINALIZE_JOB, IMAGE_WORKER, ITEM_FAILURE,
                       update_item_status)
 
 __all__ = [
-    "Engine", "MessageBus", "Reply", "BusError",
+    "Engine", "MessageBus", "Reply", "BusError", "BusClosed",
     "JobStore", "Counters", "UploadsMap", "LockTimeout",
+    "JobJournal", "JournalUnavailable",
+    "RetryPolicy", "CircuitBreaker", "BreakerRegistry", "DeadLetterLog",
     "FakeS3Client", "HttpS3Client", "S3Error", "S3UploadWorker",
     "S3UploaderConfig", "S3_UPLOADER",
     "SlackWorker", "HttpSlackClient", "RecordingSlackClient",

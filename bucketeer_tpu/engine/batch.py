@@ -18,16 +18,19 @@ import asyncio
 import inspect
 import logging
 import os
+import random
 
 from .. import config as cfg
 from .. import constants as c
 from .. import features
 from ..converters import Conversion, ConverterError
 from ..models import Job, WorkflowState
+from . import faults
 from .bus import MessageBus, Reply
+from .retry import RetryPolicy
 from .s3 import S3_UPLOADER
 from .scheduler import PRIORITY_BATCH, DeadlineExceeded, QueueFull
-from .store import JobStore, LockTimeout
+from .store import JobStore, JournalUnavailable, LockTimeout
 from .workers import (FINALIZE_JOB, ITEM_FAILURE, LARGE_IMAGE,
                       update_item_status)
 
@@ -41,12 +44,20 @@ class BatchConverterWorker:
     """The TPU stand-in for the kakadu-lambda-converter fleet: convert,
     upload the derivative, report status through the shared seam."""
 
+    # Status writes retry on transient lock/journal trouble; the budget
+    # is small (the job lock is local) but backed off + jittered like
+    # every other retry path.
+    STATUS_POLICY = RetryPolicy(max_attempts=5, base_delay=0.1,
+                                max_delay=2.0)
+
     def __init__(self, converter, store: JobStore, bus: MessageBus,
-                 config) -> None:
+                 config, counters=None) -> None:
         self.converter = converter
         self.store = store
         self.bus = bus
         self.config = config
+        self.counters = counters
+        self._rng = random.Random(0)
         # Mesh routing threshold: batch items at/above this pixel count
         # encode across the device mesh (converters/tpu.py routes a
         # giant single tile row-sharded, tiled batches data-sharded)
@@ -95,16 +106,24 @@ class BatchConverterWorker:
                 self.converter.convert).parameters:
             kwargs["priority"] = PRIORITY_BATCH
         try:
+            faults.point("batch.convert", image_id=image_id,
+                         job=job_name)
             derivative = await asyncio.to_thread(
                 self.converter.convert, image_id, file_path, conversion,
                 **kwargs)
+            jpx_name = os.path.basename(derivative)
             reply = await self.bus.request_with_retry(S3_UPLOADER, {
-                c.IMAGE_ID: os.path.basename(derivative),
+                c.IMAGE_ID: jpx_name,
                 c.FILE_PATH: derivative,
                 c.JOB_NAME: job_name,
                 c.DERIVATIVE_IMAGE: True,
             })
             ok = reply.is_success
+            if self.counters is not None:
+                # The upload settled (success, failure, or dead-letter):
+                # its per-image retry counter must not outlive it
+                # (unbounded growth over a long ingest run otherwise).
+                self.counters.reset(f"retries-{jpx_name}")
         except QueueFull as exc:
             # Encode-queue backpressure is transient by definition: the
             # bus's retry protocol requeues the item after a delay
@@ -118,7 +137,13 @@ class BatchConverterWorker:
             LOG.error("batch convert failed for %s: %s", image_id, exc)
         except Exception as exc:
             LOG.exception("batch item %s errored: %s", image_id, exc)
-        for attempt in range(3):
+        # The at-least-once window: the derivative (if any) is uploaded
+        # but the status is not yet durable. A kill here is replayed by
+        # journal recovery; resolution is idempotent so the re-run
+        # cannot double-count.
+        faults.point("batch.status", image_id=image_id, job=job_name,
+                     ok=ok)
+        for attempt in range(self.STATUS_POLICY.max_attempts):
             try:
                 await update_item_status(
                     self.store, self.bus, job_name, image_id, ok,
@@ -128,12 +153,15 @@ class BatchConverterWorker:
                 LOG.warning("job %s vanished before item %s resolved",
                             job_name, image_id)
                 break
-            except LockTimeout:
-                # A transient lock timeout must not strand the item as
-                # EMPTY forever (the job would never finalize); retry.
-                LOG.warning("job lock timeout updating %s/%s (attempt %d)",
-                            job_name, image_id, attempt + 1)
-                await asyncio.sleep(0.1 * (attempt + 1))
+            except (LockTimeout, JournalUnavailable) as exc:
+                # Transient lock/journal trouble must not strand the
+                # item as EMPTY forever (the job would never finalize);
+                # back off through the shared policy and retry.
+                LOG.warning("status write for %s/%s blocked "
+                            "(attempt %d): %s", job_name, image_id,
+                            attempt + 1, exc)
+                await asyncio.sleep(
+                    self.STATUS_POLICY.delay(attempt, self._rng))
         else:
             # Status never written: requeue the whole message rather than
             # ack it, or the item stays EMPTY and the job never finalizes.
@@ -142,9 +170,21 @@ class BatchConverterWorker:
             500, f"conversion failed for {image_id}")
 
 
+async def _pause_while_breaker_open(bus: MessageBus) -> None:
+    """Graceful degradation: when the S3 target's circuit is open, the
+    dispatcher pauses fan-out (instead of queueing work toward a dead
+    target) until the breaker's half-open window is due."""
+    breaker = bus.breakers.lookup(S3_UPLOADER)
+    while breaker is not None and breaker.is_open:
+        wait = max(0.01, min(breaker.time_until_ready(), 0.5))
+        LOG.warning("S3 circuit open; batch fan-out paused %.2fs", wait)
+        await asyncio.sleep(wait)
+
+
 async def start_job(job: Job, bus: MessageBus, config,
                     flags: features.FeatureFlagChecker,
-                    conversion: str | None = None) -> None:
+                    conversion: str | None = None,
+                    store: JobStore | None = None) -> None:
     """Dispatch every pending item of a queued job (reference:
     LoadCsvHandler.java:237-314):
 
@@ -154,15 +194,36 @@ async def start_job(job: Job, bus: MessageBus, config,
     - oversized without the flag -> item FAILED;
     - nothing runnable at all -> finalize immediately with
       ``nothing-processed`` (reference: :309-313).
+
+    With ``store`` given, each hand-off is journaled as *dispatched* so
+    a crash can tell queued-never-sent from sent-never-resolved; the
+    same function re-dispatches the surviving EMPTY items on resume
+    (it skips already-terminal items by construction).
     """
     max_size = config.get_int(cfg.MAX_SOURCE_SIZE)
     lambda_mode = (config.get_str(BATCH_MODE) or "tpu").lower() == "lambda"
     large_ok = flags.is_enabled(features.LARGE_IMAGES)
     dispatched = 0
 
+    async def _mark(item_id: str) -> None:
+        if store is not None:
+            try:
+                # Off-loop: a durable store fsyncs each mark, and a
+                # 10k-item fan-out must not freeze the event loop for
+                # 10k fsyncs.
+                await asyncio.to_thread(store.mark_dispatched,
+                                        job.name, item_id)
+            except JournalUnavailable as exc:
+                # Dispatch marks are an optimization for crash
+                # accounting, not a correctness gate — the item is
+                # still EMPTY and will re-dispatch on resume.
+                LOG.warning("dispatch mark lost for %s/%s: %s",
+                            job.name, item_id, exc)
+
     for item in job.items:
         if item.workflow_state != WorkflowState.EMPTY or not item.has_file():
             continue
+        await _pause_while_breaker_open(bus)
         path = item.get_file()
         try:
             size = os.path.getsize(path)
@@ -177,6 +238,7 @@ async def start_job(job: Job, bus: MessageBus, config,
                 # Reference flow: push the source TIFF to the lambda
                 # bucket; the external converter PATCHes back
                 # (reference: LoadCsvHandler.java:256-263).
+                await _mark(item.id)
                 ext = os.path.splitext(path)[1]
                 reply = await bus.request_with_retry(S3_UPLOADER, {
                     c.IMAGE_ID: item.id + ext,
@@ -192,6 +254,7 @@ async def start_job(job: Job, bus: MessageBus, config,
                        c.FILE_PATH: path}
                 if conversion:
                     msg[c.CONVERSION_TYPE] = conversion
+                await _mark(item.id)
                 await bus.send(BATCH_CONVERTER, msg)
             dispatched += 1
         elif large_ok:
@@ -199,6 +262,7 @@ async def start_job(job: Job, bus: MessageBus, config,
             # Send the absolute prefixed path — the same one the size check
             # used — matching the reference's source.getAbsolutePath()
             # (reference: LoadCsvHandler.java:256).
+            await _mark(item.id)
             reply = await bus.request_with_retry(LARGE_IMAGE, {
                 c.JOB_NAME: job.name, c.IMAGE_ID: item.id,
                 c.FILE_PATH: path,

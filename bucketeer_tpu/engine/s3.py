@@ -9,8 +9,12 @@ Port of the reference's S3BucketVerticle and its vertx-super-s3 client
   (:141-155);
 - success: records the upload, deletes derivative source files, replies
   ``success`` (:168-175,286-303);
-- HTTP 5xx: infinite ``retry``; other errors: bounded per-image retry
-  counter (``s3.max.retries``) then a failure reply (:185-194,219-277);
+- errors: bounded per-image retry counter (``s3.max.retries``) then a
+  failure reply (:185-194,219-277). The reference retried 5xx forever;
+  here 5xx/timeouts draw from the *same* bounded budget and trip the
+  per-target circuit breaker (engine/retry.py) — while it is open the
+  worker fast-fails with ``retry`` without touching the dead target,
+  and the half-open window admits one probe;
 - always decrements the in-flight counter (:312-336).
 
 Clients: :class:`FakeS3Client` stores objects in a local directory (the
@@ -33,7 +37,9 @@ from dataclasses import dataclass
 
 from .. import constants as c
 from .. import op
+from . import faults
 from .bus import MessageBus, Reply
+from .retry import CircuitBreaker
 from .store import Counters, UploadsMap
 
 LOG = logging.getLogger(__name__)
@@ -203,14 +209,27 @@ class S3UploadWorker:
     (reference: MainVerticle.java:233-242 deploys instances x threads)."""
 
     def __init__(self, client, config: S3UploaderConfig,
-                 counters: Counters, uploads: UploadsMap) -> None:
+                 counters: Counters, uploads: UploadsMap,
+                 breaker: CircuitBreaker | None = None) -> None:
         self.client = client
         self.config = config
         self.counters = counters
         self.uploads = uploads
+        self.breaker = breaker
 
     def register(self, bus: MessageBus, instances: int = 1) -> None:
         bus.consumer(S3_UPLOADER, self.handle, instances=instances)
+
+    @staticmethod
+    def _retryable_status(exc: Exception) -> int | None:
+        """5xx-class status when the failure is the *target's* fault
+        (server trouble or a timeout) — these trip the breaker; client
+        errors (4xx, local OSError) don't."""
+        if isinstance(exc, S3Error):
+            return exc.status if 500 <= exc.status < 600 else None
+        if isinstance(exc, (asyncio.TimeoutError, TimeoutError)):
+            return 504
+        return None
 
     async def handle(self, message: dict) -> Reply:
         image_id = message[c.IMAGE_ID]
@@ -219,10 +238,18 @@ class S3UploadWorker:
         bucket = message.get(c.S3_BUCKET) or self.config.bucket
         derivative = bool(message.get(c.DERIVATIVE_IMAGE))
 
-        # Backpressure: cap concurrent in-flight puts (reference:
-        # S3BucketVerticle.java:88-108).
+        # Backpressure first: cap concurrent in-flight puts (reference:
+        # S3BucketVerticle.java:88-108). Checked *before* the breaker
+        # so a shed message can never consume the half-open probe slot.
         in_flight = self.counters.increment(c.S3_REQUEST_COUNT)
         if in_flight > self.config.max_requests:
+            self.counters.decrement(c.S3_REQUEST_COUNT)
+            return Reply.retry()
+
+        # Circuit open: fast-fail without touching the dead target —
+        # allow() grants exactly one probe once the half-open window is
+        # due (engine/retry.py).
+        if self.breaker is not None and not self.breaker.allow():
             self.counters.decrement(c.S3_REQUEST_COUNT)
             return Reply.retry()
 
@@ -230,14 +257,34 @@ class S3UploadWorker:
         if job_name:
             metadata[c.JOB_NAME] = job_name
         try:
+            faults.point("s3.put", image_id=image_id, bucket=bucket)
             await self.client.put(bucket, image_id, file_path, metadata)
         except Exception as exc:
-            status = exc.status if isinstance(exc, S3Error) else 0
-            return self._failure_reply(image_id, status, str(exc))
+            status = self._retryable_status(exc)
+            if self.breaker is not None:
+                if status is not None:
+                    self.breaker.record_failure()
+                elif isinstance(exc, S3Error):
+                    # A 4xx is the request's fault, not the target's —
+                    # the target *answered*, so the circuit stays
+                    # healthy.
+                    self.breaker.record_success()
+                else:
+                    # Local errors (OSError on the source file, ...)
+                    # never contacted the target: no outcome for the
+                    # breaker — but if this call held the half-open
+                    # probe slot, hand it back or the breaker wedges
+                    # with a phantom probe forever.
+                    self.breaker.release_probe()
+            if status is None and isinstance(exc, S3Error):
+                status = exc.status
+            return self._failure_reply(image_id, status or 0, str(exc))
         finally:
             # Always release the in-flight slot (reference: :312-336).
             self.counters.decrement(c.S3_REQUEST_COUNT)
 
+        if self.breaker is not None:
+            self.breaker.record_success()
         self.uploads.record(image_id, {
             c.FILE_PATH: file_path, c.JOB_NAME: job_name, "bucket": bucket})
         self.counters.reset(f"retries-{image_id}")
@@ -252,15 +299,16 @@ class S3UploadWorker:
 
     def _failure_reply(self, image_id: str, status: int,
                        message: str) -> Reply:
-        if 500 <= status < 600:
-            # Server-side trouble: infinite retry (reference: :185-194).
-            LOG.warning("S3 %d for %s; retrying", status, image_id)
-            return Reply.retry()
+        # One bounded budget for every failure class. The reference
+        # retried 5xx forever (:185-194); a permanent outage now ends
+        # in a failure reply (dead-lettered by the sender) after
+        # ``s3.max.retries`` attempts instead of spinning.
         key = f"retries-{image_id}"
         attempts = self.counters.increment(key)
         if attempts <= self.config.max_retries:
-            LOG.warning("S3 error for %s (attempt %d/%d): %s", image_id,
-                        attempts, self.config.max_retries, message)
+            LOG.warning("S3 %s for %s (attempt %d/%d): %s",
+                        status or "error", image_id, attempts,
+                        self.config.max_retries, message)
             return Reply.retry()
         self.counters.reset(key)
         LOG.error("S3 upload failed permanently for %s: %s", image_id,

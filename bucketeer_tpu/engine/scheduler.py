@@ -72,6 +72,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..analysis.graftrace import seam
+from . import faults
 
 LOG = logging.getLogger(__name__)
 
@@ -382,6 +383,9 @@ class EncodeScheduler:
         when it ran — never a hang)."""
         from ..codec import encoder as encoder_mod
 
+        # graftgremlin: lets a fault scenario force admission failures
+        # (QueueFull -> 503 ladder) without filling the real queue.
+        faults.point("sched.submit", kind=kind)
         ticket = self._admit(priority, deadline_s, kind)
 
         def check() -> None:

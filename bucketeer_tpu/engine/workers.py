@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import urllib.parse
 
 from .. import config as cfg
@@ -28,12 +29,12 @@ from .. import constants as c
 from .. import features
 from .. import op
 from ..converters import Conversion, ConverterError
-from ..models import WorkflowState
 from .bus import MessageBus, Reply
+from .retry import RetryPolicy
 from .scheduler import DeadlineExceeded, QueueFull
 from .s3 import S3_UPLOADER
 from .slack import (CSV_DATA, SLACK, SLACK_CHANNEL_ID, SLACK_MESSAGE_TEXT)
-from .store import JobStore, LockTimeout
+from .store import JobStore, JournalUnavailable, LockTimeout
 
 LOG = logging.getLogger(__name__)
 
@@ -53,11 +54,13 @@ class ImageWorker:
 
     def __init__(self, converter, bus: MessageBus,
                  http_client=None,
-                 default_conversion: str = "lossless") -> None:
+                 default_conversion: str = "lossless",
+                 counters=None) -> None:
         self.converter = converter
         self.bus = bus
         self.http_client = http_client     # async (method,url)->status
         self.default_conversion = default_conversion
+        self.counters = counters
         self.background: set[asyncio.Task] = set()
 
     def register(self, bus: MessageBus, instances: int = 1) -> None:
@@ -113,6 +116,11 @@ class ImageWorker:
             c.FILE_PATH: derivative,
             c.DERIVATIVE_IMAGE: True,
         })
+        if self.counters is not None:
+            # Settled either way: drop the per-image retry counter so a
+            # long-running service doesn't accumulate one entry per
+            # image ever uploaded.
+            self.counters.reset(f"retries-{jpx_name}")
         if callback_url:
             await self._patch_callback(callback_url, reply.is_success)
 
@@ -140,24 +148,26 @@ async def update_item_status(store: JobStore, bus: MessageBus,
     the in-process batch converter — the same seam the reference exposes
     to its Lambda; reference: BatchJobStatusHandler.java:115-197).
 
+    Resolution is *idempotent* (``JobStore.resolve_item``): a replayed
+    update — a crashed worker's re-run, a double PATCH from the Lambda —
+    on an already-terminal item neither flips the state nor re-triggers
+    finalization, so every item counts exactly once.
+
     Returns True when this update completed the job.
     """
+    access_url = None
+    if success and iiif_url:
+        # IIIF access URL = iiif.url + URL-encoded id (reference:
+        # BatchJobStatusHandler.java:162-170).
+        access_url = iiif_url.rstrip("/") + "/" + \
+            urllib.parse.quote(image_id, safe="")
     async with store.locked():
-        job = store.get(job_name)          # raises JobNotFoundError
-        item = job.find_item(image_id)
-        if item is None:
-            raise KeyError(f"item {image_id} not in job {job_name}")
-        if success:
-            item.set_state(WorkflowState.SUCCEEDED)
-            if iiif_url:
-                # IIIF access URL = iiif.url + URL-encoded id (reference:
-                # BatchJobStatusHandler.java:162-170).
-                item.access_url = iiif_url.rstrip("/") + "/" + \
-                    urllib.parse.quote(image_id, safe="")
-        else:
-            item.set_state(WorkflowState.FAILED)
-        finished = job.remaining() == 0
-    if finished:
+        # Through a thread: a durable store fsyncs the WAL record, and
+        # that latency must not stall the event loop (the store lock
+        # held across the hop keeps resolution ordering intact).
+        finished, applied = await asyncio.to_thread(
+            store.resolve_item, job_name, image_id, success, access_url)
+    if finished and applied:
         await bus.send(FINALIZE_JOB, {c.JOB_NAME: job_name})
     return finished
 
@@ -191,12 +201,20 @@ class FinalizeJobWorker:
     write it to the CSV mount (feature-flagged), and notify Slack
     (reference: verticles/FinalizeJobVerticle.java:66-181)."""
 
+    # Finalize arrives on a fire-and-forget send: nobody re-drives it
+    # if the remove hits transient lock/journal trouble, so absorb
+    # that here (bounded, backed off) or the fully-resolved job would
+    # sit in the store until a process restart's resume pass.
+    REMOVE_POLICY = RetryPolicy(max_attempts=5, base_delay=0.1,
+                                max_delay=2.0)
+
     def __init__(self, store: JobStore, bus: MessageBus, config,
                  flags: features.FeatureFlagChecker) -> None:
         self.store = store
         self.bus = bus
         self.config = config
         self.flags = flags
+        self._rng = random.Random(0)
 
     def register(self, bus: MessageBus) -> None:
         bus.consumer(FINALIZE_JOB, self.handle)
@@ -204,11 +222,29 @@ class FinalizeJobWorker:
     async def handle(self, message: dict) -> Reply:
         job_name = message[c.JOB_NAME]
         nothing_processed = bool(message.get(c.NOTHING_PROCESSED))
-        try:
-            async with self.store.locked():
-                job = self.store.remove(job_name)
-        except KeyError:
-            return Reply.failure(404, f"job not found: {job_name}")
+        for attempt in range(self.REMOVE_POLICY.max_attempts):
+            try:
+                async with self.store.locked():
+                    # Deliberately synchronous (one fsync per *job*,
+                    # not per item): no suspension point between the
+                    # job leaving the store and its CSV landing below,
+                    # so an observer polling the store never sees the
+                    # gap.
+                    job = self.store.remove(job_name)
+                break
+            except KeyError:
+                return Reply.failure(404, f"job not found: {job_name}")
+            except (LockTimeout, JournalUnavailable) as exc:
+                LOG.warning("finalize of %r blocked (attempt %d): %s",
+                            job_name, attempt + 1, exc)
+                await asyncio.sleep(
+                    self.REMOVE_POLICY.delay(attempt, self._rng))
+        else:
+            # Still stuck: leave the job for the restart resume pass
+            # (remaining()==0 jobs finalize on boot) — loudly.
+            LOG.error("finalize of %r exhausted its retry budget; "
+                      "the job stays queued until restart", job_name)
+            return Reply.failure(503, f"finalize blocked: {job_name}")
 
         job.update_metadata()
         csv_text = job.to_csv()

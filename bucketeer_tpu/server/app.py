@@ -33,6 +33,8 @@ from .. import models as m
 from ..codec.decode import DecodeError, InvalidParam
 from ..converters import TpuReader, available_converters, derivative_path
 from ..engine import Engine, start_job, update_item_status
+from ..engine.journal import JournalUnavailable
+from ..engine.s3 import S3_UPLOADER
 from ..engine.scheduler import DeadlineExceeded, QueueFull
 from ..engine.store import LockTimeout
 from ..engine.workers import IMAGE_WORKER
@@ -62,6 +64,16 @@ def _error_page(status: int, message: str,
                         headers=headers,
                         text=_html("error.html", status=status,
                                    message=message))
+
+
+def _unavailable(message: str, retry_after: float) -> web.Response:
+    """503 + Retry-After — the one shape every degradation state maps
+    to (QueueFull, open circuit, journal unavailable): the client
+    should back off and come back, nothing is broken."""
+    return _error_page(
+        503, message,
+        headers={"Retry-After":
+                 str(max(1, int(round(float(retry_after)))))})
 
 
 class Api:
@@ -97,6 +109,17 @@ class Api:
         # fleet silently running without its kernels is visible.
         from ..codec.pallas import support as pallas_support
         pallas_support.set_metrics_sink(self.metrics)
+        # Ingest-robustness counters: retry attempts, dead letters,
+        # breaker transitions (engine/retry.py) and journal records /
+        # truncated-tail recoveries (engine/journal.py) all land in the
+        # same /metrics registry.
+        from ..engine import retry as engine_retry
+        engine_retry.set_metrics_sink(self.metrics)
+        # Live breaker state (open/half_open/closed + consecutive
+        # failures) rendered as a /metrics section beside the
+        # transition counters.
+        self.metrics.add_reporter("breakers",
+                                  engine.bus.breakers.report)
         # Decode work is admitted through the same scheduler as encodes
         # (typed read-priority jobs): tile reads share the bounded
         # queue's 503 backpressure but outrank queued encodes, and the
@@ -160,10 +183,8 @@ class Api:
                 # the client when to come back instead of pretending
                 # the service broke.
                 retry_after = reply.body.get(c.RETRY_AFTER, 1)
-                return _error_page(
-                    503, reply.message or "encode queue full",
-                    headers={"Retry-After":
-                             str(max(1, int(round(float(retry_after)))))})
+                return _unavailable(
+                    reply.message or "encode queue full", retry_after)
             return _error_page(500, reply.message or "conversion failed")
         # 201 + JSON echo (reference: LoadImageHandler.java:73-75)
         return web.json_response(
@@ -241,11 +262,8 @@ class Api:
             # decomposition levels, or a region outside the image).
             return _error_page(400, str(exc))
         except (QueueFull, DeadlineExceeded) as exc:
-            retry_after = getattr(exc, "retry_after", 1)
-            return _error_page(
-                503, str(exc),
-                headers={"Retry-After":
-                         str(max(1, int(round(float(retry_after)))))})
+            return _unavailable(str(exc),
+                                getattr(exc, "retry_after", 1))
         except DecodeError as exc:
             LOG.warning("decode failed for %s: %s", image_id, exc)
             self.metrics.count("decode.failures")
@@ -284,24 +302,46 @@ class Api:
         if not csv_bytes:
             return _error_page(400, "missing required CSV upload")
 
+        # Graceful degradation (same ladder as QueueFull): a new job is
+        # not accepted while the S3 target's circuit is open — the
+        # batch would only pile work onto a dead target.
+        breaker = self.engine.bus.breakers.lookup(S3_UPLOADER)
+        if breaker is not None and breaker.is_open:
+            return _unavailable(
+                "upload target unavailable (circuit open)",
+                breaker.time_until_ready())
+
         job_name = csv_name
         # Duplicate running job -> 429 (reference: :190-202)
-        async with self.engine.store.locked():
-            if job_name in self.engine.store:
-                return _error_page(
-                    429, f"batch job '{job_name}' is already running")
-            try:
-                job = job_factory.create_job(
-                    job_name, csv_bytes.decode("utf-8", errors="replace"),
-                    subsequent_run=subsequent, prefix=self.prefix)
-                warnings: list[str] = []
-            except job_factory.JobCreationWarnings as warn:
-                job = warn.job
-                warnings = warn.errors.messages
-            except m.ProcessingException as exc:
-                return _error_page(400, "; ".join(exc.messages))
-            job.slack_handle = slack_handle
-            self.engine.store.put(job)
+        try:
+            async with self.engine.store.locked():
+                if job_name in self.engine.store:
+                    return _error_page(
+                        429, f"batch job '{job_name}' is already running")
+                try:
+                    job = job_factory.create_job(
+                        job_name,
+                        csv_bytes.decode("utf-8", errors="replace"),
+                        subsequent_run=subsequent, prefix=self.prefix)
+                    warnings: list[str] = []
+                except job_factory.JobCreationWarnings as warn:
+                    job = warn.job
+                    warnings = warn.errors.messages
+                except m.ProcessingException as exc:
+                    return _error_page(400, "; ".join(exc.messages))
+                job.slack_handle = slack_handle
+                # Off-loop: durable acceptance fsyncs the WAL record.
+                await asyncio.to_thread(self.engine.store.put, job)
+                # A fresh run of a job name must not inherit the
+                # dead letters of a finished same-named run.
+                self.engine.bus.dead_letters.clear_job(job_name)
+        except JournalUnavailable as exc:
+            # Durable acceptance is the contract: a job the journal
+            # can't record is not accepted (it would silently lose its
+            # crash-safety), so the client backs off and retries.
+            return _unavailable(str(exc), exc.retry_after)
+        except LockTimeout as exc:
+            return _unavailable(str(exc), 1.0)
 
         # Respond first, then start the work (reference: :226-230 sends
         # the success page before dispatching items).
@@ -318,7 +358,8 @@ class Api:
         try:
             with self.metrics.time("batch_dispatch"):
                 await start_job(job, self.engine.bus, self.engine.config,
-                                self.engine.flags)
+                                self.engine.flags,
+                                store=self.engine.store)
         except Exception:
             # The client already got its 200 (the success page is sent
             # before dispatch), so this log line is the only trace of a
@@ -341,8 +382,10 @@ class Api:
             return _error_page(404, f"job not found: {job_name}")
         except KeyError:
             return _error_page(404, f"item not found: {image_id}")
+        except JournalUnavailable as exc:
+            return _unavailable(str(exc), exc.retry_after)
         except LockTimeout as exc:
-            return _error_page(503, str(exc))
+            return _unavailable(str(exc), 1.0)
         return web.Response(status=204)
 
     # --- getJobs (reference: handlers/GetJobsHandler.java:31-60) ---
@@ -365,6 +408,10 @@ class Api:
                 c.STATUS: str(item.workflow_state),
                 c.FILE_PATH: item.file_path,
             } for item in job.items],
+            # Items that exhausted their retry budget (engine/retry.py)
+            # instead of spinning forever — the operator-facing record.
+            c.DEAD_LETTERS:
+                self.engine.bus.dead_letters.for_job(job_name),
         })
 
     # --- deleteJob (reference: handlers/DeleteJobHandler.java:32-120) ---
@@ -386,10 +433,13 @@ class Api:
                 400, f"job '{job_name}' is still processing")
         try:
             async with self.engine.store.locked():
-                self.engine.store.remove(job_name)
+                await asyncio.to_thread(self.engine.store.remove,
+                                        job_name)
         except KeyError:
             # Finalized (or deleted) between the probe and the remove.
             return _error_page(404, f"job not found: {job_name}")
+        except JournalUnavailable as exc:
+            return _unavailable(str(exc), exc.retry_after)
         except LockTimeout:
             # Match updateBatchJob's contention behavior: 503, not 500.
             return _error_page(503, "job lock timed out; try again")

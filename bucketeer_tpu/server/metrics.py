@@ -6,12 +6,15 @@ stage because throughput is the product metric."""
 from __future__ import annotations
 
 import contextlib
+import logging
 import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 
 from ..analysis.graftrace import seam
+
+LOG = logging.getLogger(__name__)
 
 
 @dataclass
@@ -100,6 +103,12 @@ class Metrics:
     _lock: threading.Lock = field(
         default_factory=lambda: seam.make_lock("Metrics._lock"),
         repr=False)
+    # Live-state reporters: name -> zero-arg callable returning a JSON
+    # section merged into report() (e.g. the engine's circuit-breaker
+    # registry — current state belongs in /metrics next to the
+    # transition counters). Called *outside* _lock: a reporter may take
+    # its own locks and must not nest under ours.
+    _reporters: dict = field(default_factory=dict, repr=False)
 
     @contextlib.contextmanager
     def time(self, stage: str, pixels: int = 0):
@@ -137,13 +146,28 @@ class Metrics:
             seam.write(self, "values")
             self.values[name].observe(float(value))
 
+    def add_reporter(self, name: str, fn) -> None:
+        """Attach (or replace) a live-state section of the report."""
+        with self._lock:
+            seam.write(self, "_reporters")
+            self._reporters[name] = fn
+
     def report(self) -> dict:
         with self._lock:
             seam.read(self, "stages")
             seam.read(self, "overlaps")
             seam.read(self, "counters")
             seam.read(self, "values")
-            return self._report_locked()
+            out = self._report_locked()
+            seam.read(self, "_reporters")
+            reporters = dict(self._reporters)
+        for name, fn in sorted(reporters.items()):
+            try:
+                out[name] = fn()
+            except Exception as exc:
+                # A broken reporter must not take /metrics down with it.
+                LOG.warning("metrics reporter %r failed: %s", name, exc)
+        return out
 
     def _report_locked(self) -> dict:
         out = {"uptime_s": round(time.time() - self.started_at, 1),

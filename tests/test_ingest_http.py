@@ -1,0 +1,243 @@
+"""HTTP-level ingest robustness: the degradation ladder surfaces as
+503 + Retry-After (journal unavailable, open circuit), the per-job
+dead-letter detail field, idempotent PATCH replay, /metrics counter
+visibility, and Engine journal resume after a simulated crash."""
+import asyncio
+import os
+
+import pytest
+from aiohttp import FormData
+
+from bucketeer_tpu import config as cfg
+from bucketeer_tpu import features
+from bucketeer_tpu import job_factory
+from bucketeer_tpu.engine import (Engine, FakeS3Client, JobStore,
+                                  RecordingSlackClient)
+from bucketeer_tpu.engine import faults
+from bucketeer_tpu.models import WorkflowState
+from bucketeer_tpu.server.app import build_app
+from bucketeer_tpu.utils import path_prefix as pp
+
+
+class StubConverter:
+    def __init__(self, tmpdir):
+        self.tmpdir = str(tmpdir)
+
+    def convert(self, image_id, source_path, conversion=None):
+        out = os.path.join(self.tmpdir,
+                           image_id.replace("/", "_") + ".jpx")
+        with open(out, "wb") as fh:
+            fh.write(b"JPX!")
+        return out
+
+
+CSV_TEXT = "Item ARK,File Name\nark:/1/a,imgA.tif\nark:/1/b,imgB.tif\n"
+
+
+def _write_images(tmp_path):
+    for name in ("imgA.tif", "imgB.tif"):
+        (tmp_path / name).write_bytes(b"II*\x00")
+
+
+def _csv_form(csv_text=CSV_TEXT):
+    form = FormData()
+    form.add_field("csvFileToUpload", csv_text.encode(),
+                   filename="test-job.csv", content_type="text/csv")
+    form.add_field("slack-handle", "tester")
+    return form
+
+
+def make_env(tmp_path, overrides=None):
+    config = cfg.Config.load(overrides={
+        cfg.IIIF_URL: "http://iiif.test/iiif",
+        cfg.SLACK_CHANNEL_ID: "chan",
+        cfg.FILESYSTEM_CSV_MOUNT: str(tmp_path / "csv-mount"),
+        cfg.FILESYSTEM_IMAGE_MOUNT: str(tmp_path),
+        cfg.S3_REQUEUE_DELAY: 0.01,
+        **(overrides or {})})
+    engine = Engine(
+        config,
+        flags=features.FeatureFlagChecker(
+            static={features.FS_WRITE_CSV: True}),
+        converter=StubConverter(tmp_path),
+        s3_client=FakeS3Client(str(tmp_path / "s3")),
+        slack_client=RecordingSlackClient())
+    return build_app(engine, job_delete_timeout=0.1), engine
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    yield
+    faults.install(None)
+
+
+async def _wait(cond, timeout=15.0):
+    for _ in range(int(timeout / 0.02)):
+        if cond():
+            return True
+        await asyncio.sleep(0.02)
+    return cond()
+
+
+async def test_journal_unavailable_csv_upload_503(tmp_path,
+                                                  aiohttp_client):
+    _write_images(tmp_path)
+    app, engine = make_env(tmp_path, overrides={
+        cfg.JOB_JOURNAL_DIR: str(tmp_path / "journal")})
+    client = await aiohttp_client(app)
+    faults.install(faults.FaultPlan().at(
+        "journal.write", lambda: OSError("disk gone"), times=1))
+    resp = await client.post("/batch/input/csv", data=_csv_form())
+    assert resp.status == 503
+    assert int(resp.headers["Retry-After"]) >= 1
+    assert "test-job" not in engine.store     # not half-accepted
+    # The fault budget is spent: the retried upload goes through and
+    # the job runs to completion from its durable record.
+    resp = await client.post("/batch/input/csv", data=_csv_form())
+    assert resp.status == 200
+    assert await _wait(lambda: "test-job" not in engine.store)
+    out = (tmp_path / "csv-mount" / "test-job.csv").read_text()
+    assert out.count("succeeded") == 2
+
+
+async def test_circuit_open_csv_upload_503(tmp_path, aiohttp_client):
+    _write_images(tmp_path)
+    app, engine = make_env(tmp_path)
+    client = await aiohttp_client(app)
+    for _ in range(engine.s3_breaker.threshold):
+        engine.s3_breaker.record_failure()
+    assert engine.s3_breaker.is_open
+    resp = await client.post("/batch/input/csv", data=_csv_form())
+    assert resp.status == 503
+    assert int(resp.headers["Retry-After"]) >= 1
+    assert "test-job" not in engine.store
+    engine.s3_breaker.record_success()        # weather clears
+    resp = await client.post("/batch/input/csv", data=_csv_form())
+    assert resp.status == 200
+    assert await _wait(lambda: "test-job" not in engine.store)
+
+
+async def test_dead_letters_in_job_detail_and_metrics(tmp_path,
+                                                      aiohttp_client):
+    _write_images(tmp_path)
+    app, engine = make_env(tmp_path)
+    client = await aiohttp_client(app)
+    job = job_factory.create_job(
+        "test-job", CSV_TEXT,
+        prefix=pp.GenericFilePathPrefix(str(tmp_path)))
+    job.slack_handle = "tester"
+    async with engine.store.locked():
+        engine.store.put(job)
+    engine.bus.dead_letters.record(
+        "s3-uploader", 6, "S3 503: outage", image_id="a.jpx",
+        job_name="test-job")
+    body = await (await client.get("/batch/jobs/test-job")).json()
+    assert body["dead-letters"] == [{
+        "address": "s3-uploader", "image-id": "a.jpx",
+        "job-name": "test-job", "attempts": 6,
+        "error": "S3 503: outage",
+        "at": body["dead-letters"][0]["at"]}]
+    metrics = await (await client.get("/metrics")).json()
+    assert metrics["counters"]["retry.dead_letters"] >= 1
+    # Live breaker state is a /metrics section, not just counters.
+    assert metrics["breakers"]["s3-uploader"]["state"] == "closed"
+
+
+async def test_new_run_does_not_inherit_stale_dead_letters(
+        tmp_path, aiohttp_client):
+    """Yesterday's dead letters for 'test-job' must not show up in a
+    fresh upload of the same job name."""
+    _write_images(tmp_path)
+    app, engine = make_env(tmp_path)
+    client = await aiohttp_client(app)
+    engine.bus.dead_letters.record(
+        "s3-uploader", 6, "stale", image_id="old.jpx",
+        job_name="test-job")
+    resp = await client.post("/batch/input/csv", data=_csv_form())
+    assert resp.status == 200
+    assert engine.bus.dead_letters.for_job("test-job") == []
+    assert await _wait(lambda: "test-job" not in engine.store)
+
+
+async def test_patch_replay_is_idempotent(tmp_path, aiohttp_client):
+    """A double PATCH (the Lambda retrying its callback) must not flip
+    a resolved item or re-finalize the job."""
+    _write_images(tmp_path)
+    app, engine = make_env(tmp_path)
+    client = await aiohttp_client(app)
+    job = job_factory.create_job(
+        "test-job", CSV_TEXT,
+        prefix=pp.GenericFilePathPrefix(str(tmp_path)))
+    job.slack_handle = "tester"
+    async with engine.store.locked():
+        engine.store.put(job)
+    resp = await client.patch("/batch/jobs/test-job/ark%3A%2F1%2Fa/true")
+    assert resp.status == 204
+    resp = await client.patch(
+        "/batch/jobs/test-job/ark%3A%2F1%2Fa/false")   # replayed, flips?
+    assert resp.status == 204
+    item = engine.store.get("test-job").find_item("ark:/1/a")
+    assert item.workflow_state is WorkflowState.SUCCEEDED
+
+
+async def test_engine_resumes_journaled_job_on_startup(tmp_path,
+                                                       aiohttp_client):
+    """The crash story end to end at the Engine level: a journal left
+    behind by a killed process (1 of 2 items resolved, 1 dispatched)
+    finalizes after restart with every item accounted exactly once."""
+    _write_images(tmp_path)
+    jdir = str(tmp_path / "journal")
+    # The "previous process": journal a half-done job, then vanish.
+    store = JobStore(journal_dir=jdir)
+    job = job_factory.create_job(
+        "test-job", CSV_TEXT,
+        prefix=pp.GenericFilePathPrefix(str(tmp_path)))
+    job.slack_handle = "tester"
+    store.put(job)
+    store.mark_dispatched("test-job", "ark:/1/a")
+    store.mark_dispatched("test-job", "ark:/1/b")
+    store.resolve_item("test-job", "ark:/1/a", True,
+                       "http://iiif.test/iiif/a")
+    store.close()
+
+    app, engine = make_env(tmp_path, overrides={
+        cfg.JOB_JOURNAL_DIR: jdir})
+    recovered = engine.store.get("test-job")
+    assert recovered.remaining() == 1
+    assert engine.store.dispatched("test-job") == {"ark:/1/b"}
+    client = await aiohttp_client(app)   # startup fires the resume task
+    assert await _wait(lambda: "test-job" not in engine.store)
+    out = (tmp_path / "csv-mount" / "test-job.csv").read_text()
+    # Exactly once: the pre-crash success kept its state (and URL from
+    # the journal), the dispatched-unresolved item was re-driven.
+    assert out.count("succeeded") == 2
+    assert "http://iiif.test/iiif/a" in out
+    # A fresh store over the same dir shows the finalize was journaled.
+    store2 = JobStore(journal_dir=jdir)
+    assert "test-job" not in store2
+    store2.close()
+
+
+async def test_resume_finalizes_fully_resolved_job(tmp_path,
+                                                   aiohttp_client):
+    """Crash in the gap between the last status write and the finalize
+    message: on restart the job has remaining()==0 and must finalize
+    without re-dispatching anything."""
+    _write_images(tmp_path)
+    jdir = str(tmp_path / "journal")
+    store = JobStore(journal_dir=jdir)
+    job = job_factory.create_job(
+        "test-job", CSV_TEXT,
+        prefix=pp.GenericFilePathPrefix(str(tmp_path)))
+    job.slack_handle = "tester"
+    store.put(job)
+    store.resolve_item("test-job", "ark:/1/a", True)
+    store.resolve_item("test-job", "ark:/1/b", False)
+    store.close()
+
+    app, engine = make_env(tmp_path, overrides={
+        cfg.JOB_JOURNAL_DIR: jdir})
+    client = await aiohttp_client(app)
+    assert await _wait(lambda: "test-job" not in engine.store)
+    out = (tmp_path / "csv-mount" / "test-job.csv").read_text()
+    assert "succeeded" in out and "failed" in out

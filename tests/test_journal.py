@@ -1,0 +1,254 @@
+"""Durable job store (engine/journal.py + JobStore journal mode):
+WAL replay, snapshot compaction, truncated/corrupt tails, idempotent
+resolution, replay of already-finalized jobs, and the
+dispatched-but-unresolved re-queue window."""
+import asyncio
+import json
+import os
+
+import pytest
+
+from bucketeer_tpu import job_factory
+from bucketeer_tpu.engine import faults
+from bucketeer_tpu.engine.journal import (JOURNAL, JobJournal,
+                                          JournalUnavailable)
+from bucketeer_tpu.engine.store import JobStore
+from bucketeer_tpu.models import WorkflowState
+from bucketeer_tpu.utils import path_prefix as pp
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _mk_job(tmp_path, n=3, name="j1"):
+    for i in range(n):
+        (tmp_path / f"img{i}.tif").write_bytes(b"II*\x00")
+    csv_text = "Item ARK,File Name\n" + "\n".join(
+        f"ark:/1/{i},img{i}.tif" for i in range(n)) + "\n"
+    return job_factory.create_job(
+        name, csv_text, prefix=pp.GenericFilePathPrefix(str(tmp_path)))
+
+
+def _journal_lines(jdir):
+    path = os.path.join(jdir, JOURNAL)
+    if not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+class TestReplay:
+    def test_crash_replay_restores_jobs_and_dispatch_state(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        job = _mk_job(tmp_path)
+        store.put(job)
+        store.mark_dispatched("j1", "ark:/1/0")
+        store.mark_dispatched("j1", "ark:/1/1")
+        store.resolve_item("j1", "ark:/1/0", True, "http://iiif/0")
+        store.close()
+
+        # "Crash": a fresh process loads the same directory.
+        store2 = JobStore(journal_dir=jdir)
+        assert store2.recovery["records"] == 4
+        j2 = store2.get("j1")
+        assert j2.remaining() == 2
+        item = j2.find_item("ark:/1/0")
+        assert item.workflow_state is WorkflowState.SUCCEEDED
+        assert item.access_url == "http://iiif/0"
+        # The dispatched-but-unresolved item is exactly the re-queue set.
+        assert store2.dispatched("j1") == {"ark:/1/1"}
+
+    def test_resolution_is_idempotent_no_double_count(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        store.put(_mk_job(tmp_path, n=2))
+        fin, applied = store.resolve_item("j1", "ark:/1/0", True, "u")
+        assert (fin, applied) == (False, True)
+        # Replay (a crashed worker's re-run, a double PATCH): no state
+        # flip, no second count toward finalization.
+        fin, applied = store.resolve_item("j1", "ark:/1/0", False)
+        assert (fin, applied) == (False, False)
+        state = store.get("j1").find_item("ark:/1/0").workflow_state
+        assert state is WorkflowState.SUCCEEDED
+        fin, applied = store.resolve_item("j1", "ark:/1/1", False)
+        assert (fin, applied) == (True, True)
+        # A replayed final update reports finished but NOT applied —
+        # the caller must not re-trigger finalization.
+        fin, applied = store.resolve_item("j1", "ark:/1/1", False)
+        assert (fin, applied) == (True, False)
+        store.close()
+        # The no-op replays never reached the journal (the idempotence
+        # check runs before the WAL append), so replay is exact.
+        assert len(_journal_lines(jdir)) == 3   # put + 2 resolves
+        store2 = JobStore(journal_dir=jdir)
+        assert store2.get("j1").remaining() == 0
+        assert store2.recovery == {"snapshot": True, "records": 3,
+                                   "ignored": 0, "truncated": False}
+
+    def test_replay_of_already_finalized_job_is_ignored(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        store.put(_mk_job(tmp_path, n=1))
+        store.resolve_item("j1", "ark:/1/0", True)
+        store.remove("j1")
+        # Hand-append a stale record landing after the remove (the
+        # crash-during-finalize window).
+        journal = JobJournal(jdir)
+        journal.append({"op": "resolve", "job": "j1", "id": "ark:/1/0",
+                        "state": "FAILED", "url": None})
+        journal.append({"op": "dispatch", "job": "j1", "id": "x"})
+        journal.close()
+        store.close()
+        store2 = JobStore(journal_dir=jdir)
+        assert "j1" not in store2
+        assert store2.recovery["ignored"] >= 2
+
+    def test_truncated_tail_dropped(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        store.put(_mk_job(tmp_path, n=2))
+        store.resolve_item("j1", "ark:/1/0", True)
+        store.close()
+        # Crash mid-write: a partial record with no trailing newline.
+        with open(os.path.join(jdir, JOURNAL), "a") as fh:
+            fh.write('{"op":"resolve","job":"j1","id":"ark:/1/1","sta')
+        store2 = JobStore(journal_dir=jdir)
+        assert store2.recovery["truncated"]
+        j2 = store2.get("j1")
+        assert j2.find_item("ark:/1/0").workflow_state is \
+            WorkflowState.SUCCEEDED
+        assert j2.remaining() == 1           # the torn record is gone
+
+    def test_valid_json_broken_content_is_skipped_not_fatal(
+            self, tmp_path):
+        """A record that parses but can't replay (unknown state name,
+        missing fields — e.g. written by a different version) must
+        degrade to 'ignored', never crash recovery and block boot."""
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        store.put(_mk_job(tmp_path, n=2))
+        store.close()
+        with open(os.path.join(jdir, JOURNAL), "a") as fh:
+            fh.write('{"op":"resolve","job":"j1","id":"ark:/1/0",'
+                     '"state":"NOT_A_STATE"}\n')
+            fh.write('{"op":"resolve","job":"j1","id":"ark:/1/1",'
+                     '"state":"SUCCEEDED","url":null}\n')
+        store2 = JobStore(journal_dir=jdir)
+        assert store2.recovery["ignored"] >= 1
+        j2 = store2.get("j1")
+        assert j2.find_item("ark:/1/0").workflow_state is \
+            WorkflowState.EMPTY
+        assert j2.find_item("ark:/1/1").workflow_state is \
+            WorkflowState.SUCCEEDED
+
+    def test_journal_compacts_after_append_threshold(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        store.COMPACT_EVERY = 6
+        for k in range(3):
+            job = _mk_job(tmp_path, n=1, name=f"j{k}")
+            store.put(job)
+            store.mark_dispatched(job.name, "ark:/1/0")
+            store.resolve_item(job.name, "ark:/1/0", True)
+            store.remove(job.name)
+        # 12 appends with a threshold of 6: at least one mid-life
+        # compaction ran, so the journal is shorter than history.
+        assert len(_journal_lines(jdir)) < 12
+        store.close()
+        store2 = JobStore(journal_dir=jdir)
+        assert len(store2) == 0              # state survived compaction
+
+    def test_corrupt_middle_line_stops_replay_at_prefix(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        store.put(_mk_job(tmp_path, n=2))
+        store.close()
+        with open(os.path.join(jdir, JOURNAL), "a") as fh:
+            fh.write("NOT JSON AT ALL\n")
+            fh.write('{"op":"resolve","job":"j1","id":"ark:/1/0",'
+                     '"state":"SUCCEEDED","url":null}\n')
+        store2 = JobStore(journal_dir=jdir)
+        # Replay stops at the first bad line; the good-looking record
+        # *after* garbage is not trusted.
+        assert store2.recovery["truncated"]
+        assert store2.get("j1").remaining() == 2
+
+    def test_kill_between_upload_and_status_requeues_item(self, tmp_path):
+        """The at-least-once window: dispatch journaled, upload done,
+        no resolve — the replayed item must still be EMPTY (so it
+        re-dispatches) and counted exactly once overall."""
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        store.put(_mk_job(tmp_path, n=2))
+        store.mark_dispatched("j1", "ark:/1/0")
+        # (upload happened here; process died before the status write)
+        store.close()
+        store2 = JobStore(journal_dir=jdir)
+        j2 = store2.get("j1")
+        assert j2.find_item("ark:/1/0").workflow_state is \
+            WorkflowState.EMPTY
+        assert "ark:/1/0" in store2.dispatched("j1")
+        # The re-run resolves it once; a duplicate resolve (the
+        # pre-kill worker's status write arriving late) is a no-op.
+        assert store2.resolve_item("j1", "ark:/1/0", True) == \
+            (False, True)
+        assert store2.resolve_item("j1", "ark:/1/0", True) == \
+            (False, False)
+
+
+class TestSnapshot:
+    def test_recovery_compacts(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        store.put(_mk_job(tmp_path, n=2))
+        store.resolve_item("j1", "ark:/1/0", True)
+        store.close()
+        assert len(_journal_lines(jdir)) == 2
+        store2 = JobStore(journal_dir=jdir)
+        # Startup wrote a fresh snapshot and truncated the journal:
+        # the next crash replays state-sized work, not history-sized.
+        assert _journal_lines(jdir) == []
+        snap = json.load(open(os.path.join(jdir, "snapshot.json")))
+        assert len(snap["jobs"]) == 1
+        store2.close()
+        store3 = JobStore(journal_dir=jdir)
+        assert store3.get("j1").remaining() == 1
+
+    def test_unreadable_snapshot_falls_back_to_journal(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        store.put(_mk_job(tmp_path, n=1))
+        store.close()
+        with open(os.path.join(jdir, "snapshot.json"), "w") as fh:
+            fh.write("{broken")
+        store2 = JobStore(journal_dir=jdir)
+        assert "j1" in store2                # journal still has the put
+
+
+class TestJournalUnavailable:
+    def test_append_failure_raises_typed(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        store = JobStore(journal_dir=jdir)
+        plan = faults.FaultPlan().at(
+            "journal.write", lambda: OSError("disk gone"), times=1)
+        faults.install(plan)
+        try:
+            with pytest.raises(JournalUnavailable):
+                store.put(_mk_job(tmp_path, n=1))
+        finally:
+            faults.install(None)
+        # WAL discipline: the failed put did NOT land in memory.
+        assert "j1" not in store
+        # The journal recovers once the fault clears.
+        store.put(_mk_job(tmp_path, n=1))
+        assert "j1" in store
+
+    def test_in_memory_store_never_journals(self, tmp_path):
+        store = JobStore()
+        assert not store.durable
+        store.put(_mk_job(tmp_path, n=1))
+        store.resolve_item("j1", "ark:/1/0", True)
+        store.close()                        # no-op, no files
+        assert not (tmp_path / "journal").exists()

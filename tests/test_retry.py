@@ -1,0 +1,169 @@
+"""Unified retry policy (engine/retry.py): bounded backoff + full
+jitter, circuit breaker state machine, breaker registry, dead-letter
+log, and the /metrics counter wiring."""
+import random
+
+import pytest
+
+from bucketeer_tpu.engine.retry import (CLOSED, HALF_OPEN, OPEN,
+                                        BreakerRegistry, CircuitBreaker,
+                                        DeadLetterLog, RetryPolicy,
+                                        set_metrics_sink)
+from bucketeer_tpu.server.metrics import Metrics
+
+
+@pytest.fixture
+def sink():
+    m = Metrics()
+    set_metrics_sink(m)
+    yield m
+    set_metrics_sink(None)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestRetryPolicy:
+    def test_full_jitter_bounds(self):
+        policy = RetryPolicy(max_attempts=8, base_delay=0.5,
+                             max_delay=10.0)
+        rng = random.Random(0)
+        for attempt in range(20):
+            cap = min(10.0, 0.5 * 2 ** attempt)
+            for _ in range(50):
+                d = policy.delay(attempt, rng)
+                assert 0.0 <= d <= cap
+
+    def test_deterministic_from_seed(self):
+        policy = RetryPolicy()
+        a = [policy.delay(i, random.Random(42)) for i in range(10)]
+        b = [policy.delay(i, random.Random(42)) for i in range(10)]
+        assert a == b
+
+    def test_exhaustion_and_with_base(self):
+        policy = RetryPolicy(max_attempts=3, base_delay=1.0)
+        assert not policy.exhausted(2)
+        assert policy.exhausted(3)
+        rebased = policy.with_base(0.01)
+        assert rebased.base_delay == 0.01
+        assert rebased.max_attempts == 3
+        assert policy.base_delay == 1.0      # frozen original untouched
+
+
+class TestCircuitBreaker:
+    def test_trips_after_consecutive_failures(self, sink):
+        clock = FakeClock()
+        br = CircuitBreaker("t", threshold=3, reset_s=10.0, clock=clock)
+        assert br.state == CLOSED
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == CLOSED and br.allow()
+        br.record_failure()
+        assert br.state == OPEN and br.is_open
+        assert not br.allow()                 # fast-fail
+        assert br.time_until_ready() == pytest.approx(10.0)
+        assert sink.report()["counters"]["breaker.t.opened"] == 1
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker("t", threshold=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CLOSED             # never 3 in a row
+
+    def test_half_open_single_probe_then_close(self, sink):
+        clock = FakeClock()
+        br = CircuitBreaker("t", threshold=1, reset_s=5.0, clock=clock)
+        br.record_failure()
+        assert not br.allow()
+        clock.advance(5.0)
+        assert br.state == HALF_OPEN and not br.is_open
+        assert br.allow()                     # the single probe
+        assert not br.allow()                 # concurrent call denied
+        br.record_success()
+        assert br.state == CLOSED and br.allow()
+        counters = sink.report()["counters"]
+        assert counters["breaker.t.probes"] == 1
+        assert counters["breaker.t.closed"] == 1
+
+    def test_released_probe_does_not_wedge_half_open(self):
+        """A probe that never reached the target (local error, shed by
+        backpressure) hands its slot back: the next caller can probe —
+        the breaker must not stay HALF_OPEN with a phantom probe
+        forever."""
+        clock = FakeClock()
+        br = CircuitBreaker("t", threshold=1, reset_s=5.0, clock=clock)
+        br.record_failure()
+        clock.advance(5.0)
+        assert br.allow()                     # probe admitted...
+        br.release_probe()                    # ...but never attempted
+        assert br.allow()                     # slot free again
+        br.record_success()
+        assert br.state == CLOSED
+        br.release_probe()                    # no-op when closed
+        assert br.allow()
+
+    def test_failed_probe_reopens_full_window(self, sink):
+        clock = FakeClock()
+        br = CircuitBreaker("t", threshold=1, reset_s=5.0, clock=clock)
+        br.record_failure()
+        clock.advance(5.0)
+        assert br.allow()
+        br.record_failure()                   # probe failed
+        assert br.is_open
+        assert br.time_until_ready() == pytest.approx(5.0)
+        clock.advance(2.0)
+        assert not br.allow()
+        assert sink.report()["counters"]["breaker.t.reopened"] == 1
+
+
+class TestBreakerRegistry:
+    def test_get_is_create_once_lookup_is_not(self):
+        reg = BreakerRegistry(threshold=7, reset_s=1.0)
+        assert reg.lookup("a") is None
+        br = reg.get("a")
+        assert br.threshold == 7
+        assert reg.get("a") is br
+        assert reg.lookup("a") is br
+        custom = reg.get("b", threshold=2, reset_s=0.5)
+        assert custom.threshold == 2 and custom.reset_s == 0.5
+        assert set(reg.report()) == {"a", "b"}
+
+
+class TestDeadLetterLog:
+    def test_record_and_job_filter(self, sink):
+        log = DeadLetterLog()
+        log.record("s3-uploader", 5, "boom", image_id="x.jpx",
+                   job_name="j1")
+        log.record("s3-uploader", 3, "bust", image_id="y.jpx",
+                   job_name="j2")
+        assert len(log) == 2
+        only_j1 = log.for_job("j1")
+        assert [r["image-id"] for r in only_j1] == ["x.jpx"]
+        assert only_j1[0]["attempts"] == 5
+        assert sink.report()["counters"]["retry.dead_letters"] == 2
+
+    def test_bounded(self):
+        log = DeadLetterLog(max_records=3)
+        for i in range(10):
+            log.record("a", 1, f"e{i}")
+        assert len(log) == 3
+        assert [r.error for r in log.records()] == ["e7", "e8", "e9"]
+
+    def test_clear_job_drops_only_that_job(self):
+        log = DeadLetterLog()
+        log.record("a", 1, "x", job_name="j1")
+        log.record("a", 1, "y", job_name="j2")
+        log.clear_job("j1")
+        assert log.for_job("j1") == []
+        assert len(log.for_job("j2")) == 1
