@@ -308,7 +308,35 @@ def _tier1_split_report(img, params) -> dict:
             os.environ.pop("BUCKETEER_OVERLAP_TILES", None)
         else:
             os.environ["BUCKETEER_OVERLAP_TILES"] = prev_tiles
+    out["graftcost_prediction"] = _graftcost_prediction(out)
     return out
+
+
+def _graftcost_prediction(split: dict) -> dict:
+    """The static cost model's device-Tier-1 symbol throughput per
+    machine model (graftcost.tier1_prediction) beside the measured
+    device-MQ number, with the prediction error on the
+    backend-matching model — every bench run calibrates the model, so
+    its machine numbers are tracked against reality instead of
+    trusted."""
+    import jax
+
+    from bucketeer_tpu.analysis import graftcost
+
+    modeled = graftcost.tier1_prediction()
+    if not modeled:
+        return {}
+    entry: dict = {"modeled": modeled}
+    measured = (split.get("device_mq") or {}).get("symbols_per_s") or 0
+    entry["measured_symbols_per_s"] = measured
+    machine = "cpu" if jax.default_backend() == "cpu" else "tpu_v4"
+    entry["machine_for_error"] = machine
+    mp = modeled.get(machine, {}).get("symbols_per_s")
+    if measured and mp:
+        # Signed relative error: +1.0 means the model promised double
+        # what the hardware delivered.
+        entry["prediction_error"] = round(mp / measured - 1.0, 3)
+    return entry
 
 
 def _tier1_split_one(encoder, Metrics, img, p, mode,
@@ -431,6 +459,14 @@ def config1_single_4k(repeats: int) -> dict:
         split_img = (img if jax.default_backend() != "cpu"
                      else img[:min(size, 192), :min(size, 192)])
         result["tier1_split"] = _tier1_split_report(split_img, params)
+    # Pow-2 bucket occupancy of everything this config launched,
+    # weighted by the recorded workload-shape histogram (the graftcost
+    # seams in frontend/cxd/decode record each launch).
+    from bucketeer_tpu.analysis import graftcost
+
+    hist = graftcost.bucket_histogram()
+    if hist:
+        result["padding_waste"] = graftcost.padding_waste(hist)
     return result
 
 

@@ -10,6 +10,12 @@ enforced instead of implied:
   ctypes <-> C++ ABI cross-check for the native Tier-1 coder.
   See docs/analysis.md for every rule and the suppression syntax
   (``# graftlint: disable=<rule>``).
+- **Cost audit** (:mod:`graftcost`, ``--cost``): a static roofline &
+  memory-traffic model over the same lowered artifacts the device
+  audit produces — FLOPs, HBM bytes under a fusion-region model,
+  arithmetic intensity, sequential-scan depth and peak live buffers,
+  with ``perf-*`` rules (:mod:`rules_perf`) and tolerance-gated cost
+  fingerprints in the program manifest.
 - **Contracts** (:func:`contract`): shape/dtype declarations on codec
   entry points, enforced under tests, zero-cost in production.
 - **Retrace sentinel** (:mod:`retrace`): per-stage XLA compilation

@@ -13,6 +13,17 @@ After an intentional program change, regenerate it with
 ``--write-manifest`` and commit the result — the diff in review *is*
 the compiled-program change.
 
+``--cost`` adds the static performance layer (graftcost): the same
+lowered artifacts are walked by an op-level cost model — FLOPs, HBM
+bytes under a fusion-region materialization model, arithmetic
+intensity and roofline class against ``--machine`` (``tpu_v4`` default
+or ``cpu``), sequential-scan depth (the per-symbol CX/D+MQ trip
+counts, quantified), and peak live buffers vs the VMEM budget. The
+``perf-*`` rules (rules_perf) fire on anti-patterns; known offenders
+live in the baseline with full staleness hygiene. ``--cost-report``
+writes the machine-readable report; the cost fingerprints also join
+the manifest, where drift beyond tolerance fails ``--audit``.
+
 ``--race`` adds the dynamic layer (graftrace): the scheduler scenario
 suite is executed under the controlled scheduler, exploring
 interleavings systematically (bounded preemptions) and by seeded
@@ -34,8 +45,9 @@ import sys
 from pathlib import Path
 
 from .findings import ERROR
-from .lint import (STALE_BASELINE, Finding, load_baseline, prune_baseline,
-                   run_lint, write_baseline)
+from .lint import (STALE_BASELINE, Finding, baseline_entries_for_rules,
+                   load_baseline, prune_baseline, run_lint,
+                   write_baseline)
 
 DEFAULT_BASELINE = ".graftlint-baseline.json"
 DEFAULT_MANIFEST = ".graftaudit-manifest.json"
@@ -75,6 +87,21 @@ def main(argv=None) -> int:
                         help="on audit failure, write every lowered "
                              "program's StableHLO here (CI uploads it "
                              "as an artifact)")
+    parser.add_argument("--cost", action="store_true",
+                        help="static roofline & memory-traffic audit "
+                             "(graftcost): model FLOPs, HBM bytes, "
+                             "arithmetic intensity, sequential-scan "
+                             "depth and peak live buffers for every "
+                             "registered program, and fire the "
+                             "perf-* rules on anti-patterns")
+    parser.add_argument("--machine", default=None,
+                        choices=["tpu_v4", "cpu"],
+                        help="machine model for the roofline "
+                             "classification (default: tpu_v4)")
+    parser.add_argument("--cost-report", default=None,
+                        help="write the machine-readable cost report "
+                             "(per-program modeled cost + roofline + "
+                             "padding waste) to this JSON file")
     parser.add_argument("--race", action="store_true",
                         help="explore scheduler/cache interleavings "
                              "under the graftrace controlled scheduler "
@@ -161,9 +188,17 @@ def main(argv=None) -> int:
             print(f"  {name}: {type(exc).__name__}: {exc}")
         return 1 if issues else 0
 
-    if args.write_manifest:
+    # The compiled-artifact layers share one lowering pass: --audit,
+    # --cost and --write-manifest all consume the same run_programs()
+    # facts (registry lowering dominates their cost).
+    facts = None
+    if args.audit or args.cost or args.write_manifest:
         from . import deviceaudit
-        _, manifest, facts = deviceaudit.run_audit(manifest_path)
+        facts = deviceaudit.run_programs()
+
+    if args.write_manifest:
+        _, manifest, facts = deviceaudit.run_audit(manifest_path,
+                                                   facts=facts)
         deviceaudit.write_manifest(manifest_path, manifest)
         print(f"wrote {len(manifest['programs'])} lowered program(s) "
               f"to {manifest_path}")
@@ -181,14 +216,56 @@ def main(argv=None) -> int:
         findings += run_lint(root, baseline=baseline,
                              used_baseline=used_baseline)
 
+    # perf-* baseline entries are only exercised by the cost audit: a
+    # lint-only run can neither judge them stale, prune them, nor drop
+    # them from a rewritten baseline; a cost run additionally exempts
+    # entries naming programs this environment could not lower (the
+    # same tolerance diff_manifest extends to skipped programs).
+    perf_entries = baseline_entries_for_rules(baseline_path, "perf-")
+    exempt_fps: set = set()
+    if not args.cost:
+        exempt_fps = {e["fingerprint"] for e in perf_entries}
+
+    if args.cost:
+        from . import graftcost, rules_perf
+        machine = graftcost.MACHINES[args.machine or
+                                     graftcost.DEFAULT_MACHINE]
+        costs = [f.cost for f in facts
+                 if not f.skipped and f.cost is not None]
+        # Perf findings go through the same baseline + staleness
+        # hygiene as the AST rules: known offenders are suppressed by
+        # fingerprint, and a fixed offender's stale entry warns below.
+        for f in rules_perf.run(costs, machine):
+            if f.fingerprint() in baseline:
+                used_baseline.add(f.fingerprint())
+                continue
+            findings.append(f)
+        skipped = [f.name for f in facts if f.skipped]
+        exempt_fps |= {e["fingerprint"] for e in perf_entries
+                       if any(name in str(e.get("path", ""))
+                              for name in skipped)}
+        if args.cost_report:
+            Path(args.cost_report).write_text(
+                json.dumps(graftcost.cost_report(facts, machine),
+                           indent=2) + "\n", encoding="utf-8")
+        if not args.as_json:
+            for c in costs:
+                print(graftcost.render_cost_line(c, machine))
+            if skipped:
+                print(f"graftcost: {len(skipped)} program(s) not "
+                      f"lowerable here: {skipped}")
+
     if args.write_baseline:
-        write_baseline(baseline_path, findings)
-        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        keep = () if args.cost else perf_entries
+        write_baseline(baseline_path, findings, keep_entries=keep)
+        print(f"wrote {len(findings) + len(keep)} finding(s) to "
+              f"{baseline_path}")
         return 0
 
-    stale = baseline - used_baseline
+    stale = baseline - used_baseline - exempt_fps
     if stale and args.prune_baseline:
-        dropped = prune_baseline(baseline_path, used_baseline)
+        dropped = prune_baseline(baseline_path,
+                                 used_baseline | exempt_fps)
         print(f"pruned {dropped} stale entr{'y' if dropped == 1 else 'ies'} "
               f"from {baseline_path}")
     elif stale:
@@ -199,10 +276,9 @@ def main(argv=None) -> int:
                 "prune it with --prune-baseline", "warning"))
 
     if args.audit:
-        from . import deviceaudit
         audit_findings, _, _ = deviceaudit.run_audit(
             manifest_path, package_root=roots[0],
-            dump_dir=args.dump_dir)
+            dump_dir=args.dump_dir, facts=facts)
         findings += audit_findings
 
     if args.race:

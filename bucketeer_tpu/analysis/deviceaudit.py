@@ -49,9 +49,17 @@ import warnings
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from . import graftcost
 from .findings import ERROR, WARNING, Finding
 
 MANIFEST_NAME = ".graftaudit-manifest.json"
+
+# Relative drift in a modeled cost field (flops / hbm_bytes /
+# scan_depth / peak_live_bytes) beyond which the manifest gate fails —
+# a kernel-tuning PR that silently doubles modeled HBM traffic fails CI
+# here, with no bench run. Small churn (layout jitter, a constant
+# folded differently) stays under it.
+COST_DRIFT_TOLERANCE = 0.10
 
 DONATION_DROPPED = "audit-donation-dropped"
 STALE_DONATION = "audit-stale-donation-claim"
@@ -108,6 +116,8 @@ class ProgramFacts:
     text: str = ""                 # lowered StableHLO (for dumps)
     skipped: str = ""              # non-empty: not lowerable here
     donate_reason: str = "unusable"
+    cost: object = None            # graftcost.CostFacts (set by
+                                   # run_programs; pure fn of ``text``)
 
     def stale_donation_claim(self) -> bool:
         """True when the probe shows XLA would alias an arg the seam
@@ -319,6 +329,11 @@ def run_programs(entries=None) -> list:
     for entry in (registry() if entries is None else entries):
         facts = lower_program(entry)
         facts.donate_reason = entry.donate_reason
+        if not facts.skipped:
+            # The static cost model (graftcost) is a pure function of
+            # the lowered text; computing it here keeps the manifest's
+            # cost fingerprints in lockstep with the structural ones.
+            facts.cost = graftcost.cost_program(facts.text, facts.name)
         out.append(facts)
     return out
 
@@ -340,6 +355,8 @@ def manifest_from_facts(all_facts: list) -> dict:
             "transfers": list(f.transfers),
             "op_counts": f.op_counts,
         }
+        if f.cost is not None:
+            programs[f.name]["cost"] = f.cost.manifest_entry()
     return {"jax": jax.__version__, "programs": programs}
 
 
@@ -355,6 +372,21 @@ def write_manifest(path, manifest: dict) -> None:
                           encoding="utf-8")
 
 
+def _cost_drift(old_cost: dict, new_cost: dict) -> list:
+    """Per-field relative drifts beyond COST_DRIFT_TOLERANCE, as
+    rendered fragments ("hbm_bytes 1.2e6 -> 2.6e6 (+117%)")."""
+    frags = []
+    for key in ("flops", "hbm_bytes", "scan_depth", "peak_live_bytes"):
+        a, b = old_cost.get(key), new_cost.get(key)
+        if a is None or b is None or a == b:
+            continue
+        base = max(abs(a), 1)
+        rel = (b - a) / base
+        if abs(rel) > COST_DRIFT_TOLERANCE:
+            frags.append(f"{key} {a:g} -> {b:g} ({rel:+.0%})")
+    return frags
+
+
 def diff_manifest(old: dict | None, new: dict, skipped=()) -> list:
     """Human-readable drift lines between the checked-in manifest and
     the freshly lowered one (empty = no drift). Programs named in
@@ -362,7 +394,14 @@ def diff_manifest(old: dict | None, new: dict, skipped=()) -> list:
     everything else — fingerprint changes, op-count deltas,
     added/removed programs — is drift. A JAX version change is reported
     as one actionable line instead of a wall of per-program fingerprint
-    noise: the lowered text is version-specific by construction."""
+    noise: the lowered text is version-specific by construction.
+
+    Modeled-cost drift gets the same one-actionable-line treatment: a
+    program whose cost fingerprint (flops / HBM bytes / scan depth /
+    peak live bytes) moved beyond COST_DRIFT_TOLERANCE is reported as
+    *what got more expensive and by how much* — the perf-regression
+    gate that works without a bench run — instead of (or ahead of) the
+    raw op-count delta."""
     if old is None:
         return [f"no checked-in manifest: {len(new['programs'])} "
                 "program(s) unaccounted — regenerate with "
@@ -383,6 +422,17 @@ def diff_manifest(old: dict | None, new: dict, skipped=()) -> list:
                      "(new program — regenerate the manifest)")
     for name in sorted(set(news) & set(olds)):
         o, n = olds[name], news[name]
+        cost_frags = _cost_drift(o.get("cost", {}), n.get("cost", {}))
+        if cost_frags:
+            # The actionable line: what got more expensive, by how
+            # much, against the tolerance — one line per program.
+            lines.append(
+                f"{name}: modeled cost drifted beyond "
+                f"{COST_DRIFT_TOLERANCE:.0%} ({'; '.join(cost_frags)})"
+                " — a perf-relevant compiled-program change; if "
+                "intentional, regenerate with --write-manifest and "
+                "justify the new cost in review")
+            continue
         if o.get("fingerprint") == n["fingerprint"]:
             continue
         deltas = []
@@ -394,7 +444,8 @@ def diff_manifest(old: dict | None, new: dict, skipped=()) -> list:
         detail = ("; ".join(deltas[:8]) if deltas
                   else "same op counts, different structure")
         lines.append(f"{name}: compiled program drifted "
-                     f"({o.get('n_ops')} -> {n['n_ops']} ops: {detail})")
+                     f"({o.get('n_ops')} -> {n['n_ops']} ops: {detail}"
+                     "; modeled cost within tolerance)")
     return lines
 
 
@@ -460,15 +511,18 @@ def validate_d2h_whitelist(project) -> list:
 
 # --- the full audit ------------------------------------------------------
 
-def run_audit(manifest_path, package_root=None, dump_dir=None):
+def run_audit(manifest_path, package_root=None, dump_dir=None,
+              facts=None):
     """Lower + verify every registered program, validate the d2h
     whitelist, and diff the manifest. Returns (findings, manifest,
-    facts). On any program-level failure with ``dump_dir`` set, the
-    lowered text of every program is written there for the CI artifact
-    upload."""
+    facts). ``facts`` accepts a precomputed ``run_programs()`` result
+    so a CLI run combining ``--audit`` with ``--cost`` lowers the
+    registry once. On any program-level failure with ``dump_dir`` set,
+    the lowered text of every program is written there for the CI
+    artifact upload."""
     from .lint import load_project
 
-    all_facts = run_programs()
+    all_facts = run_programs() if facts is None else facts
     findings = []
     for facts in all_facts:
         findings += check_program(facts)

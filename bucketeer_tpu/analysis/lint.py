@@ -200,6 +200,22 @@ def load_baseline(path: Path) -> set:
             if "fingerprint" in f}
 
 
+def baseline_entries_for_rules(path: Path, prefix: str) -> list:
+    """Baseline entries (full records) whose rule starts with
+    ``prefix``. The staleness pass needs this to scope itself to rule
+    families that actually ran: a ``perf-*`` entry is only judged stale
+    by an invocation that ran the cost audit — a lint-only run must
+    neither report it stale, prune it, nor drop it from a rewritten
+    baseline."""
+    try:
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError):
+        return []
+    return [f for f in data.get("findings", [])
+            if "fingerprint" in f
+            and str(f.get("rule", "")).startswith(prefix)]
+
+
 def prune_baseline(path: Path, used: set) -> int:
     """Rewrite the baseline file keeping only entries whose fingerprint
     still suppresses a live finding; returns how many were dropped."""
@@ -217,12 +233,19 @@ def prune_baseline(path: Path, used: set) -> int:
     return dropped
 
 
-def write_baseline(path: Path, findings: list) -> None:
-    data = {"findings": [{"fingerprint": f.fingerprint(),
-                          "rule": f.rule, "path": f.path, "line": f.line}
-                         for f in findings]}
-    Path(path).write_text(json.dumps(data, indent=2) + "\n",
-                          encoding="utf-8")
+def write_baseline(path: Path, findings: list,
+                   keep_entries: list = ()) -> None:
+    """Record ``findings`` as the new baseline. ``keep_entries``
+    carries raw entries to preserve verbatim — rule families the
+    current invocation did not run (perf-* on a lint-only rewrite),
+    which would otherwise be silently dropped."""
+    entries = list(keep_entries)
+    seen = {e.get("fingerprint") for e in entries}
+    entries += [{"fingerprint": f.fingerprint(), "rule": f.rule,
+                 "path": f.path, "line": f.line}
+                for f in findings if f.fingerprint() not in seen]
+    Path(path).write_text(json.dumps({"findings": entries}, indent=2)
+                          + "\n", encoding="utf-8")
 
 
 def run_lint(root: Path, baseline: set | None = None,

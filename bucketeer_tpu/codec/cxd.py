@@ -60,7 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..analysis import retrace
+from ..analysis import graftcost, retrace
 from ..config import truthy as cfg_truthy
 from .mq import CTX_RL, CTX_UNIFORM, MQEncoder, QE_TABLE
 from .pipeline import donate_argnums_if_supported
@@ -548,6 +548,7 @@ def run_cxd(blocks_dev, nbps: np.ndarray, floors: np.ndarray,
     n = len(nbps)
     P, nbps_d, floors_d, cls, hs_d, ws_d = _pad_chunk_meta(
         int(blocks_dev.shape[0]), nbps, floors, bandnames, hs, ws, P)
+    graftcost.record_bucket("cxd.blocks", n, int(blocks_dev.shape[0]))
 
     packed, counts, dh, dl, cur = _compiled_cxd(P, frac_bits)(
         blocks_dev, jnp.asarray(nbps_d), jnp.asarray(floors_d),
@@ -857,6 +858,7 @@ def run_device_mq(blocks_dev, nbps: np.ndarray, floors: np.ndarray,
     N = int(blocks_dev.shape[0])
     P, nbps_d, floors_d, cls, hs_d, ws_d = _pad_chunk_meta(
         N, nbps, floors, bandnames, hs, ws, P)
+    graftcost.record_bucket("cxd.blocks", n, N)
 
     t0 = time.perf_counter()
     buf, counts, dh, dl, cur = _compiled_cxd(P, frac_bits, raw=True)(
@@ -877,6 +879,11 @@ def run_device_mq(blocks_dev, nbps: np.ndarray, floors: np.ndarray,
 
     t0 = time.perf_counter()
     n_steps = _mq_steps_bucket(int(cur_h.max()) if N else 1, P)
+    # The MQ scan pads its *trip count* to a pow-2 bucket the same way
+    # batches pad their leading dim: padding waste here is sequential
+    # steps, the scarcest resource the cost model tracks.
+    graftcost.record_bucket("mq.steps",
+                            int(cur_h[:n].max()) if n else 0, n_steps)
     cap = mq_capacity(n_steps)
     rows, snaps, dlen, curb = _compiled_mq(P, n_steps)(
         buf, counts, cur, jnp.asarray(flags))

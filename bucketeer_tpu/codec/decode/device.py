@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...analysis import retrace
+from ...analysis import graftcost, retrace
 from ...analysis.contracts import contract
 from ..dwt import _along_rows, _inv53_last, dwt2d_inverse
 from ..pipeline import (_band_geometry, _bucket,
@@ -336,6 +336,7 @@ def run_inverse(plan: InversePlan, hvals: np.ndarray) -> np.ndarray:
     service compiles O(log max-batch) programs per tile shape."""
     b = hvals.shape[0]
     pad = _bucket(b) - b
+    graftcost.record_bucket("decode.batch", b, b + pad)
     if pad:
         hvals = np.concatenate(
             [hvals, np.zeros((pad,) + hvals.shape[1:], hvals.dtype)])

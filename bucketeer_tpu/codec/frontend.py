@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis import retrace
+from ..analysis import graftcost, retrace
 from ..analysis.contracts import contract
 from .pipeline import (TilePlan, _bucket, _step_map, _transform_batch,
                        donate_argnums_if_supported)
@@ -320,6 +320,9 @@ def dispatch_frontend(plan: TilePlan, tiles: np.ndarray,
         tiles = tiles.astype(np.float32)
     b = tiles.shape[0]
     pad = _bucket(b) - b
+    # Workload-shape seam: graftcost weighs per-bucket padding waste by
+    # what the service actually launched (docs/analysis.md, graftcost).
+    graftcost.record_bucket("frontend.batch", b, b + pad)
     if pad:
         tiles = np.concatenate(
             [tiles, np.zeros((pad,) + tiles.shape[1:], tiles.dtype)])

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis import retrace
+from ..analysis import graftcost, retrace
 from ..analysis.contracts import contract
 from .dwt import dwt2d_forward, synthesis_gains
 from .quant import (FRAC_BITS, SubbandQuant, quantize_fp,
@@ -220,6 +220,7 @@ def run_tiles(plan: TilePlan, tiles: np.ndarray) -> np.ndarray:
         tiles = tiles[..., None]
     b = tiles.shape[0]
     pad = _bucket(b) - b
+    graftcost.record_bucket("transform.batch", b, b + pad)
     if pad:
         tiles = np.concatenate(
             [tiles, np.zeros((pad,) + tiles.shape[1:], tiles.dtype)])

@@ -1,0 +1,445 @@
+"""graftcost (analysis/graftcost.py + rules_perf.py): the op-walk cost
+model is exact on tiny hand-written programs, the registry programs
+model to the known trip counts, padding waste follows a synthetic
+bucket histogram, the perf rules fire on today's offenders (and only
+through the baseline), and the manifest drift gate catches a doubled
+modeled-traffic fingerprint.
+
+The expensive part — lowering the full registry — runs once per module
+(session fixture shared with test_deviceaudit when pytest collects
+both); the exactness tests lower tiny synthetic programs.
+"""
+import json
+from pathlib import Path
+
+import pytest
+
+from bucketeer_tpu.analysis import deviceaudit, graftcost, rules_perf
+from bucketeer_tpu.analysis.__main__ import main as cli_main
+
+REPO = Path(__file__).resolve().parent.parent
+BASELINE = REPO / ".graftlint-baseline.json"
+MANIFEST = REPO / ".graftaudit-manifest.json"
+
+
+@pytest.fixture(scope="session")
+def repo_facts():
+    return deviceaudit.run_programs()
+
+
+def _lower(fn, *avals):
+    import jax
+
+    return jax.jit(fn).lower(*avals).as_text()
+
+
+def _aval(shape, dtype="float32"):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, getattr(jnp, dtype))
+
+
+# --- op-walk exactness on hand-written programs ------------------------
+
+def test_single_dot_flops_and_bytes_are_exact():
+    """(8,16) @ (16,4) f32: 2*M*N*K = 1024 flops; HBM = both inputs
+    read + the output written = 512 + 256 + 128 = 896 bytes."""
+    c = graftcost.cost_program(
+        _lower(lambda x, w: x @ w, _aval((8, 16)), _aval((16, 4))),
+        "dot")
+    assert c.flops == 2 * 8 * 4 * 16
+    assert c.hbm_bytes == 8 * 16 * 4 + 16 * 4 * 4 + 8 * 4 * 4
+    assert c.scan_depth == 0 and c.n_whiles == 0
+    assert c.input_bytes == 8 * 16 * 4 + 16 * 4 * 4
+    assert c.output_bytes == 8 * 4 * 4
+
+
+def test_fused_elementwise_chain_reads_input_once():
+    """(x + 1) * (x + 1) on (4,4) f32 is one fused kernel: 16 adds +
+    16 muls + the broadcast constant; HBM = x read once + result
+    written once = 128 bytes. No intermediate materializes."""
+    c = graftcost.cost_program(
+        _lower(lambda x: (x + 1) * (x + 1), _aval((4, 4))), "fused")
+    assert c.hbm_bytes == 64 + 64
+    assert 32 <= c.flops <= 64          # adds + mul (+ broadcast noise)
+
+
+def test_anchor_materializes_known_intermediate():
+    """y = x @ w then y + 1: the dot is a fusion boundary, so y is
+    written by the dot AND re-read by the add — its 128 bytes are
+    charged twice, on top of the dot's input reads and the final
+    write."""
+    def f(x, w):
+        return (x @ w) + 1.0
+
+    c = graftcost.cost_program(
+        _lower(f, _aval((8, 16)), _aval((16, 4))), "dot+add")
+    y_bytes = 8 * 4 * 4
+    base = 8 * 16 * 4 + 16 * 4 * 4          # dot input reads
+    assert c.hbm_bytes == base + y_bytes + y_bytes + y_bytes
+    # dot write ^        re-read ^   final write ^
+
+
+def test_fused_value_entering_anchor_is_written():
+    """x + x feeding a reduce: the fused intermediate materializes at
+    the anchor boundary — one write (at the boundary) plus one read
+    (by the reduce), per the documented accounting. Bytes: read x +
+    write (x+x) + read (x+x) at the reduce + write the scalar out."""
+    import jax.numpy as jnp
+
+    c = graftcost.cost_program(
+        _lower(lambda x: jnp.sum(x + x), _aval((32, 32))), "add+reduce")
+    n = 32 * 32 * 4
+    # + 4 for the scalar output, + 4 for the reduce's init-constant
+    # read (constants are read-only: no write-back is charged).
+    assert c.hbm_bytes == n + n + n + 4 + 4
+
+
+def test_scan_of_known_trip_count():
+    import jax.numpy as jnp
+    from jax import lax
+
+    def f(x):
+        def step(c, t):
+            return c + t, None
+        c, _ = lax.scan(step, jnp.zeros((4,), jnp.float32), x)
+        return c
+
+    c = graftcost.cost_program(_lower(f, _aval((7, 4))), "scan")
+    assert c.n_whiles == 1
+    assert c.max_trip == 7
+    assert c.scan_depth == 7
+    assert c.unknown_trips == 0
+    # Body work is charged per trip: at least 7 adds of 4 elements.
+    assert c.flops >= 7 * 4
+
+
+def test_nested_scans_multiply_sequential_depth():
+    import jax.numpy as jnp
+    from jax import lax
+
+    def f(x):
+        def outer(c, row):
+            def inner(a, t):
+                return a + t, None
+            a, _ = lax.scan(inner, c, row)
+            return a, None
+        c, _ = lax.scan(outer, jnp.zeros((), jnp.float32), x)
+        return c
+
+    c = graftcost.cost_program(_lower(f, _aval((5, 3))), "nested")
+    assert c.scan_depth == 5 * 3
+
+
+def test_roofline_classification_and_machine_table():
+    mem = graftcost.CostFacts("m", flops=10, hbm_bytes=10 ** 9)
+    cpu = graftcost.MACHINES["cpu"]
+    tpu = graftcost.MACHINES["tpu_v4"]
+    assert mem.roofline(cpu)["bound"] == "memory"
+    comp = graftcost.CostFacts("c", flops=10 ** 13, hbm_bytes=8)
+    assert comp.roofline(tpu)["bound"] == "compute"
+    seq = graftcost.CostFacts("s", flops=8, hbm_bytes=8,
+                              scan_depth=10 ** 6)
+    assert seq.roofline(tpu)["bound"] == "sequential"
+    # The ridge is where the two sides meet; both shipped machines
+    # keep it in a plausible flop/byte band.
+    for m in (cpu, tpu):
+        assert 0.5 < m.ridge() < 100
+
+
+def test_vmem_fit_flag():
+    tpu = graftcost.MACHINES["tpu_v4"]
+    small = graftcost.CostFacts("a", flops=1, hbm_bytes=1,
+                                peak_live_bytes=1024)
+    big = graftcost.CostFacts("b", flops=1, hbm_bytes=1,
+                              peak_live_bytes=tpu.vmem_bytes + 1)
+    assert small.roofline(tpu)["fits_vmem"]
+    assert not big.roofline(tpu)["fits_vmem"]
+
+
+# --- padding waste vs a synthetic bucket histogram ---------------------
+
+def test_padding_waste_weighted_by_histogram():
+    hist = {"cxd.blocks": {(3, 8): 2, (8, 8): 1},
+            "frontend.batch": {(1, 1): 4}}
+    waste = graftcost.padding_waste(hist)
+    blocks = waste["cxd.blocks"]
+    # (3+3+8) real out of (8+8+8) padded -> 10/24 wasted.
+    assert blocks["waste"] == round(1 - 14 / 24, 4)
+    assert blocks["launches"] == 3
+    assert blocks["buckets"]["8"]["waste"] == round(1 - 14 / 24, 4)
+    assert waste["frontend.batch"]["waste"] == 0.0
+
+
+def test_record_bucket_seam_roundtrip():
+    graftcost.reset_histogram()
+    try:
+        graftcost.record_bucket("t", 3, 4)
+        graftcost.record_bucket("t", 3, 4)
+        graftcost.record_bucket("t", 4, 4)
+        hist = graftcost.bucket_histogram()
+        assert hist == {"t": {(3, 4): 2, (4, 4): 1}}
+        assert graftcost.padding_waste(hist)["t"]["waste"] == round(
+            1 - 10 / 12, 4)
+    finally:
+        graftcost.reset_histogram()
+
+
+def test_encode_records_bucket_histogram():
+    """The codec seams actually fire: a tiny encode populates the
+    frontend-batch family with full (real == padded) buckets."""
+    import numpy as np
+
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+
+    graftcost.reset_histogram()
+    try:
+        img = np.random.default_rng(3).integers(
+            0, 255, (64, 64), dtype=np.uint8)
+        encoder.encode_jp2(img, 8, EncodeParams(lossless=True))
+        hist = graftcost.bucket_histogram()
+        assert "frontend.batch" in hist
+        assert all(real <= padded
+                   for fam in hist.values() for real, padded in fam)
+    finally:
+        graftcost.reset_histogram()
+
+
+# --- the registry programs ---------------------------------------------
+
+def _costs(repo_facts):
+    return [f.cost for f in repo_facts
+            if not f.skipped and f.cost is not None]
+
+
+def test_registry_programs_all_model(repo_facts):
+    costs = {c.name.split("/")[0]: c for c in _costs(repo_facts)}
+    assert len(costs) >= 8
+    for c in costs.values():
+        assert c.hbm_bytes > 0, c.name
+        assert c.unknown_trips == 0, (
+            f"{c.name}: unreadable while trip count — the cost model "
+            "lost the scan depth")
+
+
+def test_cxd_scan_trip_count_is_quantified(repo_facts):
+    """The acceptance number: the CX/D scan's sequential trip count is
+    P * 3 passes * 16 stripes * 64 columns = 6144 at the audit bucket
+    (P=2), and the MQ scan is per-symbol (1024 bucketed steps). These
+    are the ROADMAP elephant, pinned statically."""
+    costs = {c.name.split("/")[0]: c for c in _costs(repo_facts)}
+    assert costs["cxd.scan"].max_trip == 2 * 3 * 16 * 64
+    assert costs["cxd.scan.raw"].max_trip == 2 * 3 * 16 * 64
+    assert costs["mq.scan"].max_trip == 1024
+    # Scans dominate their modeled time on every machine model.
+    for fam in ("cxd.scan", "mq.scan"):
+        for m in graftcost.MACHINES.values():
+            assert costs[fam].roofline(m)["bound"] == "sequential"
+
+
+def test_transform_and_inverse_are_memory_bound(repo_facts):
+    costs = {c.name.split("/")[0]: c for c in _costs(repo_facts)}
+    tpu = graftcost.MACHINES["tpu_v4"]
+    for fam in ("pipeline.transform", "decode.inverse",
+                "frontend.gather"):
+        assert costs[fam].roofline(tpu)["bound"] == "memory", fam
+
+
+# --- perf rules + baseline hygiene -------------------------------------
+
+def test_perf_rules_fire_on_known_offenders(repo_facts):
+    findings = rules_perf.run(_costs(repo_facts),
+                              graftcost.MACHINES["tpu_v4"])
+    by_rule: dict = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    scans = {f.path for f in by_rule[rules_perf.SCAN_PER_ELEMENT]}
+    assert any("cxd.scan" in p for p in scans)
+    assert any("mq.scan" in p for p in scans)
+    # The (N, max_syms) symbol buffer round-trip is on record.
+    rt = by_rule[rules_perf.HBM_ROUNDTRIP]
+    assert any("cxd.scan.raw" in f.path and "mq.scan" in f.path
+               for f in rt)
+    low = by_rule[rules_perf.LOW_INTENSITY]
+    assert all(".pallas" in f.path for f in low)
+    assert all(f.severity == "warning" for f in findings)
+
+
+def test_known_offenders_are_baselined(repo_facts):
+    """Every current perf finding's fingerprint is in the checked-in
+    baseline — the build stays green while the debt stays visible."""
+    from bucketeer_tpu.analysis.lint import load_baseline
+
+    baseline = load_baseline(BASELINE)
+    findings = rules_perf.run(_costs(repo_facts),
+                              graftcost.MACHINES["tpu_v4"])
+    assert findings, "expected today's offenders to fire"
+    missing = [f.render() for f in findings
+               if f.fingerprint() not in baseline]
+    assert missing == [], missing
+
+
+def test_cli_cost_strict_passes_on_repo(capsys):
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--cost", "--strict",
+                   "--baseline", str(BASELINE)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    # The report lines carry flops/bytes/intensity/scan depth for the
+    # registered programs, including the quantified CX/D trip count.
+    assert "cxd.scan/P2/N1" in out and "scan depth 6144" in out
+    assert "intensity" in out and "MB HBM" in out and "MFLOP" in out
+
+
+def test_cli_cost_report_json(tmp_path, capsys):
+    report = tmp_path / "cost.json"
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--cost", "--machine",
+                   "cpu", "--baseline", str(BASELINE),
+                   "--cost-report", str(report)])
+    assert rc == 0, capsys.readouterr().out
+    data = json.loads(report.read_text(encoding="utf-8"))
+    assert data["machine"] == "cpu"
+    progs = data["programs"]
+    assert "cxd.scan/P2/N1" in progs
+    entry = progs["cxd.scan/P2/N1"]
+    for key in ("flops", "hbm_bytes", "intensity", "scan_depth",
+                "peak_live_bytes", "roofline"):
+        assert key in entry, key
+    assert entry["roofline"]["bound"] == "sequential"
+
+
+def test_stale_perf_baseline_entry_fails_strict(tmp_path, capsys):
+    """A fixed offender leaves a stale baseline line: --cost --strict
+    must fail on it (same hygiene as every other rule), while a
+    lint-only run must leave perf entries alone."""
+    data = json.loads(BASELINE.read_text(encoding="utf-8"))
+    data["findings"].append({
+        "fingerprint": "deadbeefdeadbeef",
+        "rule": "perf-scan-per-element",
+        "path": "<graftcost:ghost.scan/P9/N1>", "line": 0})
+    tampered = tmp_path / "baseline.json"
+    tampered.write_text(json.dumps(data) + "\n", encoding="utf-8")
+
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--cost", "--strict",
+                   "--baseline", str(tampered)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "stale-baseline-entry" in out and "deadbeefdeadbeef" in out
+
+    # Without --cost the perf family did not run: the same baseline
+    # must pass a strict lint, stale perf entries not judged.
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--strict",
+                   "--baseline", str(tampered)])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_lint_only_write_baseline_preserves_perf_entries(tmp_path,
+                                                         capsys):
+    """A plain --write-baseline (the documented AST-baseline refresh)
+    must not drop the perf-* entries it did not re-derive — losing
+    them would break the next --cost --strict run."""
+    import shutil
+
+    working = tmp_path / "baseline.json"
+    shutil.copy(BASELINE, working)
+    before = {e["fingerprint"] for e in json.loads(
+        working.read_text(encoding="utf-8"))["findings"]}
+    assert before, "expected checked-in perf entries"
+
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--write-baseline",
+                   "--baseline", str(working)])
+    assert rc == 0, capsys.readouterr().out
+    after = json.loads(working.read_text(encoding="utf-8"))["findings"]
+    kept = {e["fingerprint"] for e in after
+            if e.get("rule", "").startswith("perf-")}
+    assert before <= kept | {e["fingerprint"] for e in after}
+    assert kept == before
+
+
+def test_skipped_program_perf_entries_are_not_stale(tmp_path,
+                                                    monkeypatch,
+                                                    capsys,
+                                                    repo_facts):
+    """An environment that cannot lower a program (facts.skipped) must
+    not judge that program's perf baseline entries stale — mirrors
+    diff_manifest's skipped= tolerance."""
+    import copy
+
+    from bucketeer_tpu.analysis import deviceaudit as da
+
+    hobbled = copy.deepcopy(repo_facts)
+    for f in hobbled:
+        if f.name.startswith("mq.scan.pallas"):
+            f.skipped = "synthetic: not lowerable here"
+            f.cost = None
+    monkeypatch.setattr(da, "run_programs",
+                        lambda entries=None: copy.deepcopy(hobbled))
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--cost", "--strict",
+                   "--baseline", str(BASELINE)])
+    out = capsys.readouterr().out
+    assert "not lowerable here" in out
+    assert rc == 0, out
+
+
+# --- the manifest drift gate -------------------------------------------
+
+def test_doubled_modeled_traffic_fails_drift_gate(repo_facts):
+    """The acceptance scenario: a program whose modeled HBM traffic
+    doubles (same structural fingerprint or not) fails the manifest
+    gate with one actionable line naming the field and the growth."""
+    manifest = deviceaudit.manifest_from_facts(repo_facts)
+    name = "cxd.scan/P2/N1"
+    tampered = json.loads(json.dumps(manifest))
+    tampered["programs"][name]["cost"]["hbm_bytes"] //= 2
+    drift = deviceaudit.diff_manifest(tampered, manifest)
+    lines = [l for l in drift if name in l]
+    assert len(lines) == 1, drift
+    assert "hbm_bytes" in lines[0] and "+100%" in lines[0]
+    assert "modeled cost drifted" in lines[0]
+
+
+def test_cost_within_tolerance_is_not_drift(repo_facts):
+    manifest = deviceaudit.manifest_from_facts(repo_facts)
+    name = "cxd.scan/P2/N1"
+    nudged = json.loads(json.dumps(manifest))
+    cost = nudged["programs"][name]["cost"]
+    cost["hbm_bytes"] = int(cost["hbm_bytes"] * 1.05)
+    cost["flops"] = int(cost["flops"] * 0.95)
+    assert deviceaudit.diff_manifest(nudged, manifest) == []
+
+
+def test_scan_depth_drift_is_reported(repo_facts):
+    """The other direction matters too: a tuning PR claiming
+    'stripe-column vectorization cut trip count 4x' shows up here as a
+    scan_depth line — the claim is checkable without a TPU."""
+    manifest = deviceaudit.manifest_from_facts(repo_facts)
+    name = "cxd.scan/P2/N1"
+    tampered = json.loads(json.dumps(manifest))
+    tampered["programs"][name]["cost"]["scan_depth"] *= 4
+    drift = deviceaudit.diff_manifest(tampered, manifest)
+    lines = [l for l in drift if name in l]
+    assert len(lines) == 1 and "scan_depth" in lines[0]
+
+
+def test_checked_in_manifest_carries_cost_fingerprints():
+    manifest = deviceaudit.load_manifest(MANIFEST)
+    assert manifest is not None
+    for name, prog in manifest["programs"].items():
+        assert "cost" in prog, name
+        for key in ("flops", "hbm_bytes", "scan_depth", "max_trip",
+                    "peak_live_bytes", "intensity"):
+            assert key in prog["cost"], (name, key)
+
+
+# --- the bench-calibration prediction ----------------------------------
+
+def test_tier1_prediction_shape():
+    pred = graftcost.tier1_prediction()
+    assert set(pred) == set(graftcost.MACHINES)
+    for entry in pred.values():
+        assert entry["symbols_per_s"] > 0
+        assert entry["modeled_block_s"] > 0
+    # The TPU model must beat the CPU model on the same programs.
+    assert (pred["tpu_v4"]["symbols_per_s"]
+            > pred["cpu"]["symbols_per_s"])
