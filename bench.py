@@ -919,6 +919,102 @@ def config8_tile_storm(repeats: int) -> dict:
     }
 
 
+def config10_tensor_codec(repeats: int) -> dict:
+    """Compressed-domain tensor delivery (ISSUE 13), both products.
+
+    (a) coefficient reads: decode_to_coefficients (Tier-1 + dequant,
+    device-resident subbands) vs a full pixel decode of the same
+    stream — coefficient MB/s and the read speedup from skipping the
+    inverse DWT / color transform.
+    (b) the tensor codec: encode_tensor/decode_tensor MB/s and the
+    compression ratio vs np.savez_compressed on the same array. The
+    device-MQ chain is sequential-scan-bound on CPU (the graftcost
+    elephant), so the CPU sweep codes a low-plane int8 workload;
+    BENCH_TENSOR_FLOAT=1 (or a real accelerator) adds the float32
+    checkpoint-style workload. Env: BENCH_COEFF_SIZE,
+    BENCH_TENSOR_ELEMS, BENCH_TENSOR_BACKEND (device|replay|host)."""
+    import io
+
+    from bucketeer_tpu import tensor as tensor_mod
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.decode import decode
+    from bucketeer_tpu.codec.encoder import EncodeParams
+
+    # --- (a) coefficient reads vs full decode --------------------------
+    size = _env_int("BENCH_COEFF_SIZE", 256, smoke=96)
+    img = synthetic_photo(size)
+    params = EncodeParams(lossless=True, levels=4,
+                          tile_size=min(128, size))
+    data = encoder.encode_jp2(img, 8, params)
+    cs = tensor_mod.decode_to_coefficients(data)      # warm compiles
+    decode(data)
+    best_coeff, cs = _timed(
+        lambda: tensor_mod.decode_to_coefficients(data), repeats)
+    best_full, _ = _timed(lambda: decode(data), repeats)
+    coeff_mb = cs.nbytes / 1e6
+    coefficients = {
+        "image": f"{size}x{size}x3 uint8 lossless",
+        "coefficient_bytes": cs.nbytes,
+        "seconds": round(best_coeff, 3),
+        "mb_per_s": round(coeff_mb / best_coeff, 3),
+        "full_decode_seconds": round(best_full, 3),
+        "speedup_vs_full_decode": round(best_full / best_coeff, 3),
+        "bands": len(cs.bands),
+    }
+
+    # --- (b) the tensor codec ------------------------------------------
+    backend = os.environ.get("BENCH_TENSOR_BACKEND", "device")
+    n = _env_int("BENCH_TENSOR_ELEMS", 16384, smoke=8192)
+    rng = np.random.default_rng(1013)
+    workloads = {
+        # Quantized-checkpoint-like: low-entropy small-range int8 —
+        # few magnitude planes, so the sequential device scans stay
+        # affordable on the CPU backend too.
+        "int8_quantized": (rng.normal(0.0, 2.0, size=n)
+                           .clip(-7, 7).round().astype(np.int8)),
+    }
+    if os.environ.get("BENCH_TENSOR_FLOAT", "") not in ("", "0"):
+        workloads["float32_weights"] = (
+            rng.standard_normal(n).astype(np.float32) * 0.02)
+    tensors = {}
+    for name, arr in workloads.items():
+        blob = tensor_mod.encode_tensor(arr, device=backend)  # warm
+        best_enc, blob = _timed(
+            lambda a=arr: tensor_mod.encode_tensor(a, device=backend),
+            repeats)
+        best_dec, out = _timed(
+            lambda b=blob: tensor_mod.decode_tensor(b), repeats)
+        if not np.array_equal(
+                out.view((np.uint8, out.dtype.itemsize)),
+                arr.view((np.uint8, arr.dtype.itemsize))):
+            raise AssertionError(f"{name}: lossy roundtrip")
+        buf = io.BytesIO()
+        np.savez_compressed(buf, arr=arr)
+        mb = arr.nbytes / 1e6
+        tensors[name] = {
+            "elements": int(arr.size),
+            "raw_bytes": int(arr.nbytes),
+            "coded_bytes": len(blob),
+            "ratio": round(arr.nbytes / len(blob), 3),
+            "savez_bytes": buf.getbuffer().nbytes,
+            "ratio_vs_savez": round(
+                buf.getbuffer().nbytes / len(blob), 3),
+            "encode_mb_per_s": round(mb / best_enc, 4),
+            "decode_mb_per_s": round(mb / best_dec, 4),
+            "backend": backend,
+        }
+
+    head = tensors["int8_quantized"]
+    return {
+        "value": head["encode_mb_per_s"], "unit": "MB/s",
+        "seconds": round(head["raw_bytes"] / 1e6
+                         / max(head["encode_mb_per_s"], 1e-9), 3),
+        "coefficients": coefficients,
+        "tensors": tensors,
+        "repeats": repeats,
+    }
+
+
 CONFIGS = {
     "1_single_4k_rate3": config1_single_4k,
     "2_batch_2k_lossy": config2_batch_2k,
@@ -928,7 +1024,58 @@ CONFIGS = {
     "6_decode_roundtrip": config6_decode,
     "7_concurrent_serving": config7_concurrent_serving,
     "8_tile_storm": config8_tile_storm,
+    "10_tensor_codec": config10_tensor_codec,
 }
+
+
+def _last_valid_headline() -> dict | None:
+    """The most recent recorded headline with a real value, for the
+    carry-forward when a run doesn't execute config 1 (BENCH_r06
+    recorded only decode configs and emitted headline 0.0, which the
+    gate then had nothing to protect). Scans the checked-in BENCH_r*
+    records newest-first, then BENCH_REF.json."""
+    import glob
+
+    def doc_of(path):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError:
+            return None
+        try:
+            # Whole-file JSON: either a bare bench line or the run
+            # driver's wrapper with the line under "parsed" (r01-r05).
+            whole = json.loads(text)
+            if isinstance(whole, dict):
+                if "metric" in whole and "value" in whole:
+                    return whole
+                parsed = whole.get("parsed")
+                if isinstance(parsed, dict) and "value" in parsed:
+                    return parsed
+        except ValueError:
+            pass
+        doc = None
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    cand = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(cand, dict) and "value" in cand:
+                    doc = cand
+        return doc
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    candidates = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                        reverse=True)
+    candidates.append(os.path.join(root, "BENCH_REF.json"))
+    for path in candidates:
+        doc = doc_of(path)
+        if doc and float(doc.get("value") or 0.0) > 0:
+            return {"value": float(doc["value"]),
+                    "source": os.path.basename(path)}
+    return None
 
 
 def main() -> int:
@@ -975,9 +1122,22 @@ def main() -> int:
     entries_after = compile_cache_entries()
     headline = results.get("1_single_4k_rate3", {})
     value = headline.get("value", 0.0)
+    # Headline hygiene: a run that didn't execute (or couldn't finish)
+    # config 1 must not publish 0.0 as the number of record — carry the
+    # last valid headline forward, flagged stale so the gate skips it.
+    headline_stale = False
+    headline_from = None
+    if not value:
+        prev = _last_valid_headline()
+        if prev:
+            value = prev["value"]
+            headline_stale = True
+            headline_from = prev["source"]
     print(json.dumps({
         "metric": "lossy_jp2_encode_throughput",
         "value": value,
+        "headline_stale": headline_stale,
+        "headline_from": headline_from,
         "unit": "MPix/s",
         "vs_baseline": round(value / BASELINE_MPIX_S, 4),
         "platform": backend["platform"],

@@ -78,6 +78,12 @@ def check(current: dict, reference: dict,
     cur_p = current.get("platform")
     if ref_v <= 0:
         return True, "reference has no headline value; gate skipped"
+    if current.get("headline_stale"):
+        # The run did not execute the headline config; its value is a
+        # carry-forward from an earlier record (bench.py flags it), so
+        # gating on it would re-judge an old measurement.
+        return True, ("headline carried forward from "
+                      f"{current.get('headline_from')}; gate skipped")
     if ref_p != cur_p:
         return True, (f"platform mismatch (ref {ref_p}, run {cur_p}); "
                       "gate skipped")

@@ -232,6 +232,39 @@ def registry() -> list:
         lambda: frontend.gather_program()
         + ([sds((84, 512), jnp.uint8), sds((4096,), jnp.int64)],),
         probe_donate=(), donate_reason="lifetime"))
+
+    # Compressed-domain tensor delivery (bucketeer_tpu/tensor/): the
+    # tensor codec's block packer (the staged limb buffer becomes the
+    # HBM-resident CX/D input; donation verified unusable — reshape
+    # changes the aval) and the coefficient dequantizer (Tier-1
+    # half-magnitudes -> device-resident subband coefficients; input
+    # donated on the reversible int32->int32 path, verified dropped on
+    # the float32 path). The CX/D + MQ programs the tensor codec
+    # chains after the packer are the cxd.scan.raw / mq.scan entries
+    # above — one program, two workloads.
+    from ..tensor import codec as tcodec
+    from ..tensor import coeffs as tcoeffs
+
+    entries.append(AuditProgram(
+        "tensor.pack/B4",
+        lambda: tcodec.pack_program()
+        + ([sds((4 * 4096,), jnp.int32)],)))
+
+    def dq_entry(reversible, deltas, shapes):
+        def build():
+            fn, donate = tcoeffs.dequant_program(reversible, deltas)
+            return fn, donate, [sds(s, jnp.int32) for s in shapes]
+        return build
+
+    dq_shapes = ((1, 16, 16), (1, 16, 16), (1, 16, 16), (1, 16, 16),
+                 (1, 32, 32), (1, 32, 32), (1, 32, 32))
+    entries.append(AuditProgram(
+        "decode.coeffs.dequant/gray-reversible-L2",
+        dq_entry(True, (1.0,) * 7, dq_shapes),
+        donate_reason="declared"))
+    entries.append(AuditProgram(
+        "decode.coeffs.dequant/gray-irreversible-L2",
+        dq_entry(False, (0.5,) * 7, dq_shapes)))
     return entries
 
 

@@ -52,6 +52,7 @@ def warm_imports() -> None:
     from ...converters import reader  # noqa: F401
     from ...engine import scheduler  # noqa: F401
     from ...server import metrics  # noqa: F401
+    from ... import tensor  # noqa: F401  (submit_tensor's services seam)
 
 
 class _FakePending:
@@ -326,6 +327,92 @@ def shutdown_drain(ctl):
     dt = sched._device_thread
     assert dt is None or not dt.is_alive(), \
         "device thread resurrected after close()"
+
+
+@scenario("tensor_vs_read_priority")
+def tensor_vs_read_priority(ctl):
+    """Tensor-codec jobs and region reads through the shared scheduler
+    queue (ISSUE 13): with the one slot held, a queued read-priority
+    ticket must be granted before any queued tensor job in every
+    schedule (no starvation of PRIORITY_READ behind batch-class tensor
+    work), and close() with a tensor job still queued must cancel it
+    *typed* (SchedulerClosed) — never a hang, never an untyped
+    error."""
+    from ...engine.scheduler import (PRIORITY_TENSOR, SchedulerClosed)
+
+    sched, sink = _mk_sched(max_concurrent=1, window_s=0)
+    # The tensor entry itself: runs the job in a granted slot with the
+    # codec's deadline seam installed, returns its value.
+    assert sched.submit_tensor(lambda: 41 + 1) == 42
+    release = seam.make_event("scenario.release")
+    started = seam.make_event("scenario.started")
+    outcome = {}
+
+    def blocker():
+        def hold():
+            started.set()
+            release.wait()
+        sched.submit(hold)
+
+    tb = ctl.spawn(blocker, "blocker")
+    started.wait()
+    # Both contenders admitted deterministically (tensor first) while
+    # the only slot is held: priority, not arrival order, must decide
+    # who gets the freed slot.
+    t_tensor = sched._admit(PRIORITY_TENSOR, None, "tensor")
+    t_read = sched._admit(-1, None, "decode")
+    order = []
+
+    def waiter(t, tag):
+        sched._await_slot(t)
+        order.append(tag)
+        sched._finish(t)
+
+    w_t = ctl.spawn(lambda: waiter(t_tensor, "tensor"), "tensor")
+    w_r = ctl.spawn(lambda: waiter(t_read, "read"), "read")
+    release.set()
+    tb.join()
+    w_t.join()
+    w_r.join()
+    assert order[0] == "read", order
+
+    # Round 2: a queued tensor job at close() time fails typed.
+    started2 = seam.make_event("scenario.started2")
+    release2 = seam.make_event("scenario.release2")
+
+    def blocker2():
+        def hold():
+            started2.set()
+            release2.wait()
+        try:
+            sched.submit(hold)
+        except SchedulerClosed:
+            pass
+
+    tb2 = ctl.spawn(blocker2, "blocker2")
+    started2.wait()
+
+    def queued_tensor():
+        try:
+            sched.submit_tensor(lambda: None)
+            outcome["queued"] = "ran"
+        except SchedulerClosed:
+            outcome["queued"] = "closed"
+
+    tq = ctl.spawn(queued_tensor, "queued-tensor")
+
+    def closer():
+        release2.set()
+        sched.close()
+
+    tc = ctl.spawn(closer, "closer")
+    tb2.join()
+    tq.join()
+    tc.join()
+    assert outcome.get("queued") in ("ran", "closed"), outcome
+    assert sched.stats()["admitted"] == 0, sched.stats()
+    counters = sink.report().get("counters", {})
+    assert counters.get("tensor.admission_rejects", 0) == 0, counters
 
 
 @scenario("worker_crash_requeue")

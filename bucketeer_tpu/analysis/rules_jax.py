@@ -65,13 +65,19 @@ SYNC_METHODS = {"item", "tolist", "block_until_ready",
 # the only d2h traffic of the full-device Tier-1 chain), the mesh
 # single-tile transform exit, and the decode subsystem's device->host
 # boundary (decode.device.run_inverse — the reconstructed sample batch
-# is the decoder's product; there is nothing smaller to ship).
+# is the decoder's product; there is nothing smaller to ship). The
+# tensor subsystem adds two: tensor.codec.fetch_block_meta (the pack
+# stage's 4-bytes-per-block magnitude maxima — the blocks themselves
+# stay in HBM for the CX/D scan) and CoefficientSet.to_host (the
+# explicit materialization escape of the otherwise device-resident
+# coefficient product).
 D2H_SANCTIONED = {"fetch_payload", "gather_rows", "run_frontend",
                   "run_tiles", "run_tiles_sharded", "resolve_stats",
                   "_host_stats", "run_cxd", "run_device_mq",
                   "sharded_transform_tile",
-                  "run_inverse", "run_region_inverse"}
-D2H_SCOPES = ("codec", "parallel")
+                  "run_inverse", "run_region_inverse",
+                  "fetch_block_meta", "to_host"}
+D2H_SCOPES = ("codec", "parallel", "tensor")
 
 
 @dataclass

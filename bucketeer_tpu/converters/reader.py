@@ -65,7 +65,9 @@ class _DecodeCache:
     """Bounded LRU of decoded arrays, sized in bytes. Entries are
     returned write-locked (``setflags(write=False)``) so a caller
     mutating a cached array fails loudly instead of corrupting every
-    later hit."""
+    later hit. Coefficient reads cache their CoefficientSet through
+    the same tier (``nbytes``-sized like an array; its bands are
+    immutable jax arrays, so no write lock is needed or possible)."""
 
     def __init__(self, max_bytes: int) -> None:
         self.max_bytes = max_bytes
@@ -89,7 +91,8 @@ class _DecodeCache:
         concurrent misses don't count each other's evictions)."""
         if arr.nbytes > self.max_bytes:
             return 0                    # bigger than the whole budget
-        arr.setflags(write=False)
+        if hasattr(arr, "setflags"):
+            arr.setflags(write=False)
         evicted_here = 0
         with self._lock:
             seam.write(self, "_entries")
@@ -293,32 +296,13 @@ class TpuReader:
                     self._index_builds.pop(ikey, None)
                 pending.set()
 
-    def _decode(self, data: bytes, reduce: int, layers, region,
-                index_fn):
-        """Run the decode — and, for region reads, the index build
-        that precedes it — inside the scheduler's admitted read slot
-        when one is installed. A cold read's header walk is the most
-        expensive host work on the path, so it must pay the same
-        admission cost (bounded queue -> 503) as the decode itself;
-        single-flight waiters are safe here because the builder is by
-        construction already running in a granted slot."""
-        def job():
-            idx = index_fn() if index_fn is not None else None
-            return decode(data, reduce=reduce, layers=layers,
-                          region=region, index=idx)
-        if self.scheduler is not None:
-            return self.scheduler.read(job)
-        return job()
-
-    def read(self, source_path: str, reduce: int = 0,
-             layers: int | None = None,
-             region: tuple | None = None) -> np.ndarray:
-        """Decode a JP2/JPX file (or raw codestream) from disk;
-        ``region=(x, y, w, h)`` decodes only that window (bit-exact
-        crop of the full decode, served via the stream index).
-        Missing files raise ConverterError; malformed content raises
-        the decoder's typed DecodeError. Cache hits return a read-only
-        array — copy before mutating."""
+    def _cached_read(self, source_path: str, reduce: int, layers,
+                     region, *, coefficients: bool):
+        """The shared tiered-cache machinery behind :meth:`read` and
+        :meth:`read_coefficients` — one protocol (file identity, region
+        clamp normalization with the probe-and-recheck on first touch,
+        per-tier counters, scheduler-admitted misses), two products
+        keyed apart by a trailing ``coefficients=True`` dimension."""
         try:
             st = os.stat(source_path)
         except OSError:
@@ -326,15 +310,20 @@ class TpuReader:
                 f"derivative not found: {source_path}") from None
         region = _norm_region(region)
         fid = (source_path, st.st_mtime_ns, st.st_size)
+        suffix = (True,) if coefficients else ()
+
+        def cache_key(region):
+            return fid + (reduce, layers, region) + suffix
+
         dims = self._dims.get(fid) if region is not None else None
         if dims is not None:
             region = _clamp_region(region, *dims)
-        key = fid + (reduce, layers, region)
+        key = cache_key(region)
         if self.cache is not None:
-            img = self.cache.get(key)
-            if img is not None:
+            out = self.cache.get(key)
+            if out is not None:
                 self._count("decode.cache_hits")
-                return img
+                return out
         with open(source_path, "rb") as fh:
             data = fh.read()
         if region is not None and dims is None:
@@ -351,22 +340,68 @@ class TpuReader:
                 clamped = _clamp_region(region, *dims)
                 if clamped != region:
                     region = clamped
-                    key = fid + (reduce, layers, region)
+                    key = cache_key(region)
                     if self.cache is not None:
-                        img = self.cache.get(key)
-                        if img is not None:
+                        out = self.cache.get(key)
+                        if out is not None:
                             self._count("decode.cache_hits")
-                            return img
+                            return out
         if self.cache is not None:
             self._count("decode.cache_misses")
-        index_fn = ((lambda: self._stream_index(source_path, st, data))
-                    if region is not None else None)
-        img = self._decode(data, reduce, layers, region, index_fn)
+
+        # The decode — and, for region reads, the stream-index build
+        # that precedes it — runs inside the scheduler's admitted read
+        # slot when one is installed. A cold read's header walk is the
+        # most expensive host work on the path, so it must pay the same
+        # admission cost (bounded queue -> 503) as the decode itself;
+        # single-flight index waiters are safe here because the builder
+        # is by construction already running in a granted slot.
+        def job():
+            idx = (self._stream_index(source_path, st, data)
+                   if region is not None else None)
+            if coefficients:
+                from ..tensor import decode_to_coefficients
+
+                return decode_to_coefficients(
+                    data, region=region, reduce=reduce, layers=layers,
+                    index=idx)
+            return decode(data, reduce=reduce, layers=layers,
+                          region=region, index=idx)
+        out = (self.scheduler.read(job) if self.scheduler is not None
+               else job())
         if self.cache is not None:
-            evicted = self.cache.put(key, img)
+            evicted = self.cache.put(key, out)
             if evicted and self.metrics is not None:
                 self.metrics.count("decode.cache_evictions", evicted)
-        return img
+        return out
+
+    def read(self, source_path: str, reduce: int = 0,
+             layers: int | None = None,
+             region: tuple | None = None) -> np.ndarray:
+        """Decode a JP2/JPX file (or raw codestream) from disk;
+        ``region=(x, y, w, h)`` decodes only that window (bit-exact
+        crop of the full decode, served via the stream index).
+        Missing files raise ConverterError; malformed content raises
+        the decoder's typed DecodeError. Cache hits return a read-only
+        array — copy before mutating."""
+        return self._cached_read(source_path, reduce, layers, region,
+                                 coefficients=False)
+
+    def read_coefficients(self, source_path: str, reduce: int = 0,
+                          layers: int | None = None,
+                          region: tuple | None = None):
+        """Compressed-domain read: decode the derivative to
+        device-resident per-subband coefficient tensors
+        (tensor/coeffs.py) instead of pixels, stopping after Tier-1 +
+        dequantization. Served through the same tiered cache as pixel
+        reads — the key gains a ``coefficients=True`` dimension, so a
+        repeated compressed-domain read of the same region hits the
+        decoded-tile tier (same per-tier hit/miss/eviction counters) —
+        and cache misses run as admitted read-priority jobs when a
+        scheduler is installed. Region reads reuse the stream-index
+        tier (single-flight builds) exactly like :meth:`read`."""
+        return self._cached_read(source_path, reduce, layers, region,
+                                 coefficients=True)
 
     def reset_caches(self, tiles: bool = True,
                      index: bool = False) -> None:

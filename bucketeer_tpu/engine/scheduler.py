@@ -79,6 +79,9 @@ LOG = logging.getLogger(__name__)
 PRIORITY_READ = -1       # interactive tile/region reads outrank encodes
 PRIORITY_SINGLE = 0      # interactive single-image requests
 PRIORITY_BATCH = 1       # CSV batch items yield to interactive traffic
+PRIORITY_TENSOR = 1      # tensor-codec jobs: batch-class, never ahead
+                         # of interactive reads (graftrace scenario
+                         # tensor_vs_read_priority pins this)
 
 # Upper bound on tiles per merged device launch: keeps the padded HBM
 # staging (rows buffers) bounded however many requests pile up.
@@ -398,6 +401,10 @@ class EncodeScheduler:
 
         try:
             self._await_slot(ticket)
+            if kind == "tensor":
+                from ..tensor import tensor_services
+                with tensor_services(check=check):
+                    return fn(*args, **kwargs)
             if kind != "encode":
                 from ..codec.decode import t1_dec
                 with t1_dec.decode_services(check=check):
@@ -418,6 +425,20 @@ class EncodeScheduler:
         exactly like encode submissions."""
         return self.submit(fn, *args, priority=priority,
                            deadline_s=deadline_s, kind="decode",
+                           **kwargs)
+
+    def submit_tensor(self, fn, *args, priority: int = PRIORITY_TENSOR,
+                      deadline_s: float | None = None, **kwargs):
+        """Run a tensor-codec job (encode_tensor / decode_tensor /
+        decode_to_coefficients work) through the shared admission
+        queue: tensor jobs are batch-class — interactive region reads
+        (:data:`PRIORITY_READ`) are always granted slots first — and
+        past the bounded queue the caller gets :class:`QueueFull` ->
+        503 + Retry-After like every other kind. The codec's
+        ``tensor_services`` deadline hook is installed for the job's
+        duration (polled between chunks/blocks)."""
+        return self.submit(fn, *args, priority=priority,
+                           deadline_s=deadline_s, kind="tensor",
                            **kwargs)
 
     def encode_array(self, img, bitdepth: int = 8, params=None,

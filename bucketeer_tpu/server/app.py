@@ -109,6 +109,12 @@ class Api:
         # fleet silently running without its kernels is visible.
         from ..codec.pallas import support as pallas_support
         pallas_support.set_metrics_sink(self.metrics)
+        # Compressed-domain tensor delivery: the tensor codec reports
+        # its encode/decode stages and byte counters into the same
+        # registry (tensor.encode / tensor.encode_device /
+        # tensor.decode segments, tensor.* counters).
+        from .. import tensor as tensor_mod
+        tensor_mod.set_metrics_sink(self.metrics)
         # Ingest-robustness counters: retry attempts, dead letters,
         # breaker transitions (engine/retry.py) and journal records /
         # truncated-tail recoveries (engine/journal.py) all land in the
@@ -275,6 +281,188 @@ class Api:
             bitdepth = (await asyncio.to_thread(
                 self.reader.probe, path))["bitdepth"]
         return _image_response(img, fmt, bitdepth)
+
+    # --- getCoefficients (new: compressed-domain delivery — the
+    # "RGB no more" read path; serves the subband coefficient tensors
+    # a training job consumes instead of pixels) ---
+    async def get_coefficients(self, request: web.Request) -> web.Response:
+        """Decode the stored derivative to per-subband coefficient
+        tensors (Tier-1 + dequantization only; no inverse DWT / color
+        transform). Query: ``region=x,y,w,h``, ``reduce``, ``layers``
+        as on the pixel read. Response: an ``.npz`` stream with one
+        ``r{res}_{name}`` array per subband plus an ``X-Coeff-Meta``
+        JSON header (geometry, quantizer steps, region windows).
+        Admitted at read priority: past the bounded queue the answer
+        is 503 + Retry-After."""
+        image_id = urllib.parse.unquote(request.match_info["image_id"])
+        try:
+            reduce = int(request.query.get("reduce", "0"))
+            layers = (int(request.query["layers"])
+                      if "layers" in request.query else None)
+        except ValueError:
+            return _error_page(400, "reduce/layers must be integers")
+        if reduce < 0 or (layers is not None and layers < 1):
+            return _error_page(400, "reduce must be >= 0, layers >= 1")
+        path = derivative_path(image_id)
+        if path is None:
+            return _error_page(404, f"no derivative for: {image_id}")
+        region_q = request.query.get("region")
+        region = None
+        if region_q and region_q != "full":
+            parts = region_q.split(",")
+            if len(parts) != 4:
+                return _error_page(400, "region must be x,y,w,h or full")
+            try:
+                region = tuple(int(v) for v in parts)
+            except ValueError:
+                return _error_page(
+                    400, "region coordinates must be integers")
+        self.metrics.count("decode.requests")
+        try:
+            with self.metrics.time("coefficients_read"):
+                cs = await asyncio.to_thread(
+                    self.reader.read_coefficients, path, reduce,
+                    layers, region)
+        except InvalidParam as exc:
+            return _error_page(400, str(exc))
+        except (QueueFull, DeadlineExceeded) as exc:
+            return _unavailable(str(exc),
+                                getattr(exc, "retry_after", 1))
+        except DecodeError as exc:
+            LOG.warning("coefficient decode failed for %s: %s",
+                        image_id, exc)
+            self.metrics.count("decode.failures")
+            return _error_page(500, f"decode failed: {exc}")
+        # The d2h materialization + npz serialization are hundreds of
+        # ms for a large image — off the event loop like the decode.
+        return await asyncio.to_thread(_coefficients_response, cs)
+
+    # --- putTensor / getTensor (new: the general bit-plane tensor
+    # codec as a service — checkpoint/activation compression through
+    # the device Tier-1 kernels) ---
+    async def put_tensor(self, request: web.Request) -> web.Response:
+        """Encode the request body (an ``.npy`` tensor) through the
+        bit-plane codec and store the container beside the image
+        derivatives. Query: ``planes=k`` keeps only the top k payload
+        planes (encode-time floors); ``rate=b`` truncates the lossless
+        encode to a byte budget. 201 + stats on success; 400 for bodies
+        the codec cannot serve; 503 + Retry-After under admission
+        backpressure (tensor jobs are batch-class — interactive reads
+        outrank them in the shared scheduler queue)."""
+        import io
+
+        import numpy as np
+
+        from .. import tensor as tensor_mod
+        from ..converters.base import output_path
+        from ..engine.scheduler import get_scheduler
+
+        tensor_id = urllib.parse.unquote(request.match_info["tensor_id"])
+        try:
+            planes = (int(request.query["planes"])
+                      if "planes" in request.query else None)
+            rate = (int(request.query["rate"])
+                    if "rate" in request.query else None)
+        except ValueError:
+            return _error_page(400, "planes/rate must be integers")
+        body = await request.read()
+        if not body:
+            return _error_page(400, "missing .npy request body")
+        try:
+            arr = np.load(io.BytesIO(body), allow_pickle=False)
+        except Exception:
+            return _error_page(400, "request body is not a valid .npy")
+        self.metrics.count("tensor.encode_requests")
+        try:
+            with self.metrics.time("tensor_encode"):
+                blob = await asyncio.to_thread(
+                    get_scheduler().submit_tensor,
+                    tensor_mod.encode_tensor, arr, planes=planes,
+                    rate=rate)
+        except TypeError as exc:
+            return _error_page(400, str(exc))
+        except ValueError as exc:
+            return _error_page(400, str(exc))
+        except (QueueFull, DeadlineExceeded) as exc:
+            return _unavailable(str(exc),
+                                getattr(exc, "retry_after", 1))
+        path = output_path(tensor_id, ".btt")
+        # Unique temp name: concurrent PUTs of the same id must not
+        # interleave writes before the atomic replace (the converter's
+        # derivative writes follow the same rule).
+        tmp = f"{path}.{os.getpid()}.{id(blob):x}.part"
+        def _write():
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, path)
+        await asyncio.to_thread(_write)
+        stats = tensor_mod.tensor_stats(blob)
+        stats["tensor-id"] = tensor_id
+        return web.json_response(stats, status=201)
+
+    async def get_tensor(self, request: web.Request) -> web.Response:
+        """Decode a stored tensor container back to an ``.npy`` stream
+        (``format=blob`` returns the raw progressive container;
+        ``planes=k`` truncates on the fly at a plane boundary before
+        decoding). 503 + Retry-After under admission backpressure."""
+        import io
+
+        import numpy as np
+
+        from .. import tensor as tensor_mod
+        from ..converters.base import output_path
+        from ..engine.scheduler import get_scheduler
+
+        tensor_id = urllib.parse.unquote(request.match_info["tensor_id"])
+        fmt = request.query.get("format", "npy")
+        if fmt not in ("npy", "blob"):
+            return _error_page(400, f"unknown format: {fmt}")
+        try:
+            planes = (int(request.query["planes"])
+                      if "planes" in request.query else None)
+        except ValueError:
+            return _error_page(400, "planes must be an integer")
+        path = output_path(tensor_id, ".btt")
+        exists = await asyncio.to_thread(os.path.exists, path)
+        if not exists:
+            return _error_page(404, f"no tensor for: {tensor_id}")
+        def _read():
+            with open(path, "rb") as fh:
+                return fh.read()
+        blob = await asyncio.to_thread(_read)
+        self.metrics.count("tensor.decode_requests")
+        try:
+            if fmt == "blob":
+                if planes is not None:
+                    blob = await asyncio.to_thread(
+                        tensor_mod.truncate_tensor, blob, planes=planes)
+                return web.Response(
+                    body=blob, content_type="application/octet-stream",
+                    headers={"X-Tensor-Format": "btt1"})
+            with self.metrics.time("tensor_decode"):
+                arr = await asyncio.to_thread(
+                    get_scheduler().submit_tensor,
+                    tensor_mod.decode_tensor, blob, planes=planes)
+        except ValueError as exc:
+            return _error_page(400, str(exc))
+        except (QueueFull, DeadlineExceeded) as exc:
+            return _unavailable(str(exc),
+                                getattr(exc, "retry_after", 1))
+        except DecodeError as exc:
+            LOG.warning("tensor decode failed for %s: %s",
+                        tensor_id, exc)
+            self.metrics.count("tensor.decode_failures")
+            return _error_page(500, f"tensor decode failed: {exc}")
+        def _serialize():
+            buf = io.BytesIO()
+            np.save(buf, arr)
+            return buf.getvalue()
+        body = await asyncio.to_thread(_serialize)
+        return web.Response(
+            body=body,
+            content_type="application/octet-stream",
+            headers={"X-Tensor-Shape": "x".join(map(str, arr.shape)),
+                     "X-Tensor-Dtype": str(arr.dtype)})
 
     # --- loadImagesFromCSV (reference: handlers/LoadCsvHandler.java:100-230) ---
     async def load_csv(self, request: web.Request) -> web.Response:
@@ -450,6 +638,35 @@ class Api:
         return web.json_response(self.metrics.report())
 
 
+def _coefficients_response(cs) -> web.Response:
+    """Serialize a CoefficientSet: one npz stream (band key
+    ``r{res}_{name}``) + an X-Coeff-Meta JSON header with the geometry
+    a consumer needs to interpret the planes."""
+    import io
+
+    import numpy as np
+
+    host = cs.to_host()
+    buf = io.BytesIO()
+    np.savez(buf, **{f"r{res}_{name}": arr
+                     for (res, name), arr in host.items()})
+    meta = {
+        "width": cs.width, "height": cs.height,
+        "components": cs.n_comps, "bitdepth": cs.bitdepth,
+        "levels": cs.levels, "reduce": cs.reduce,
+        "reversible": cs.reversible, "mct": cs.used_mct,
+        "deltas": {f"r{res}_{name}": delta
+                   for (res, name), delta in cs.deltas.items()},
+    }
+    if cs.region is not None:
+        meta["region"] = list(cs.region)
+        meta["windows"] = {f"r{res}_{name}": list(win)
+                           for (res, name), win in cs.windows.items()}
+    return web.Response(
+        body=buf.getvalue(), content_type="application/octet-stream",
+        headers={"X-Coeff-Meta": json.dumps(meta)})
+
+
 def _image_response(img, fmt: str, bitdepth: int = 8) -> web.Response:
     """Serialize a decoded array: PNG for viewers (deep RGB is
     downshifted to 8 bits using the stream's true bit depth — PNG RGB48
@@ -509,7 +726,14 @@ def build_app(engine: Engine,
     app.router.add_get("/status", api.get_status)
     app.router.add_get("/config", api.get_config)
     app.router.add_get("/images/{image_id}", api.get_image)
+    # Registered before the loadImage catch-all so the literal
+    # "coefficients" segment routes here (a source file named exactly
+    # "coefficients" would have to be loaded by absolute path).
+    app.router.add_get("/images/{image_id}/coefficients",
+                       api.get_coefficients)
     app.router.add_get("/images/{image_id}/{file_path:.+}", api.load_image)
+    app.router.add_post("/tensors/{tensor_id}", api.put_tensor)
+    app.router.add_get("/tensors/{tensor_id}", api.get_tensor)
     app.router.add_post("/batch/input/csv", api.load_csv)
     app.router.add_patch(
         "/batch/jobs/{job_name}/{image_id:.+}/{success:(?:true|false)}",

@@ -1,0 +1,262 @@
+"""Compressed-domain coefficient delivery (ISSUE 13):
+decode_to_coefficients is bit-exact against slicing the subband state
+out of a full decode — full reads, region+reduce+layers windows (with
+and without the stream index), across 5/3 and 9/7, gray/RGB, 16-bit,
+multi-tile — with the results device-resident; plus the reader's
+tiered-cache integration and typed parameter errors.
+"""
+import numpy as np
+import pytest
+
+from bucketeer_tpu.codec import encoder
+from bucketeer_tpu.codec.decode import (DecodeError, InvalidParam,
+                                        build_index)
+from bucketeer_tpu.codec.decode import decoder as decoder_mod
+from bucketeer_tpu.codec.decode import parser
+from bucketeer_tpu.codec.encoder import EncodeParams
+from bucketeer_tpu.codec.pipeline import _band_geometry
+from bucketeer_tpu.tensor import decode_to_coefficients
+from bucketeer_tpu.tensor.coeffs import (band_downsample, band_keys,
+                                         band_window)
+
+
+def _expected_bands(data: bytes, reduce: int = 0, layers=None) -> dict:
+    """Oracle: the subband state of a full decode — Tier-1
+    half-magnitudes of every tile, dequantized with the decoder's own
+    rule, assembled per band across the tile grid (prefix-sum
+    origins), independently of the implementation under test."""
+    ps = parser.parse(data, reduce=reduce, layers=layers)
+    levels = ps.levels - reduce
+    n_tx = -(-ps.width // ps.tile_w)
+    tiles = {}
+    for tile in ps.tiles:
+        hv, *_ = decoder_mod._tile_hvals(ps, tile, reduce)
+        tiles[divmod(tile.idx, n_tx)] = hv
+    out = {}
+    for key in band_keys(levels):
+        rows = []
+        for ty in sorted({t[0] for t in tiles}):
+            cols = []
+            for tx in sorted({t[1] for t in tiles}):
+                hv = tiles[(ty, tx)]
+                for name, lvl, y0, x0, bh, bw in _band_geometry(
+                        hv.shape[1], hv.shape[2], levels):
+                    res = 0 if name == "LL" else levels - lvl + 1
+                    if (res, name) == key:
+                        cols.append(hv[:, y0:y0 + bh, x0:x0 + bw])
+                        break
+            rows.append(np.concatenate(cols, axis=2))
+        band = np.concatenate(rows, axis=1)
+        if ps.reversible:
+            mag = np.abs(band) >> 1
+            out[key] = np.where(band < 0, -mag, mag)
+        else:
+            delta = float(ps.quants[key].delta)
+            out[key] = (band.astype(np.float32)
+                        * np.float32(delta * 0.5))
+    return out
+
+
+def _encode(rng, shape, lossless=True, levels=2, bitdepth=8,
+            tile_size=None, **kw):
+    img = rng.integers(0, 1 << bitdepth, size=shape).astype(
+        np.uint8 if bitdepth <= 8 else np.uint16)
+    params = EncodeParams(lossless=lossless, levels=levels,
+                          **({"tile_size": tile_size} if tile_size
+                             else {}), **kw)
+    return img, encoder.encode_jp2(img, bitdepth, params)
+
+
+@pytest.mark.parametrize("shape,lossless,bitdepth", [
+    ((96, 120), True, 8),            # gray 5/3
+    ((96, 96, 3), False, 8),         # RGB 9/7 + ICT
+    ((80, 64), True, 16),            # 16-bit archival
+])
+def test_full_read_matches_subband_slicing(rng, shape, lossless,
+                                           bitdepth):
+    img, data = _encode(rng, shape, lossless=lossless, bitdepth=bitdepth)
+    cs = decode_to_coefficients(data)
+    expected = _expected_bands(data)
+    assert set(cs.bands) == set(expected)
+    host = cs.to_host()
+    for key, exp in expected.items():
+        assert host[key].dtype == exp.dtype
+        np.testing.assert_array_equal(host[key], exp, err_msg=str(key))
+    assert cs.reversible is lossless
+    assert cs.nbytes == sum(a.nbytes for a in host.values())
+
+
+def test_bands_are_device_resident(rng):
+    import jax
+
+    _, data = _encode(rng, (64, 64))
+    cs = decode_to_coefficients(data)
+    for arr in cs.bands.values():
+        assert isinstance(arr, jax.Array)
+
+
+@pytest.mark.parametrize("lossless,shape,reduce", [
+    (True, (96, 120), 0),
+    (True, (96, 120), 1),
+    (False, (96, 96, 3), 0),
+    (False, (96, 96, 3), 1),
+    (True, (80, 64), 0),             # 16-bit below
+])
+def test_region_read_matches_full_slicing(rng, lossless, shape, reduce):
+    bitdepth = 16 if shape == (80, 64) else 8
+    img, data = _encode(rng, shape, lossless=lossless,
+                        bitdepth=bitdepth)
+    full = decode_to_coefficients(data, reduce=reduce).to_host()
+    h, w = shape[:2]
+    region = (w // 4 + 1, h // 3, w // 2, h // 2 + 3)
+    idx = build_index(data)
+    for use_idx in (None, idx):
+        cs = decode_to_coefficients(data, region=region, reduce=reduce,
+                                    index=use_idx)
+        x, y, rw, rh = region
+        s = 1 << reduce
+        for key in band_keys(cs.levels):
+            d = band_downsample(key[0], cs.levels)
+            fb = full[key]
+            # The documented mapping: region -> reduced sample window
+            # -> dyadic band window, clamped.
+            w0, w1 = band_window(y // s, -(-min(y + rh, h) // s), d,
+                                 fb.shape[1])
+            c0, c1 = band_window(x // s, -(-min(x + rw, w) // s), d,
+                                 fb.shape[2])
+            assert cs.windows[key] == (w0, w1, c0, c1), key
+            np.testing.assert_array_equal(
+                np.asarray(cs.bands[key]), fb[:, w0:w1, c0:c1],
+                err_msg=f"{key} idx={use_idx is not None}")
+
+
+def test_multi_tile_full_and_region(rng):
+    img, data = _encode(rng, (96, 144), levels=2, tile_size=64,
+                        gen_plt=True)
+    expected = _expected_bands(data)
+    cs = decode_to_coefficients(data)
+    host = cs.to_host()
+    for key, exp in expected.items():
+        np.testing.assert_array_equal(host[key], exp, err_msg=str(key))
+    # A window straddling all tile boundaries.
+    cs2 = decode_to_coefficients(data, region=(30, 20, 80, 60),
+                                 index=build_index(data))
+    for key, win in cs2.windows.items():
+        np.testing.assert_array_equal(
+            np.asarray(cs2.bands[key]),
+            host[key][:, win[0]:win[1], win[2]:win[3]],
+            err_msg=str(key))
+
+
+def test_layers_truncation_matches_full(rng):
+    img, data = _encode(rng, (96, 96), lossless=False, levels=2,
+                        base_delta=2.0, rate=1.0)
+    full = _expected_bands(data, layers=1)
+    host = decode_to_coefficients(data, layers=1).to_host()
+    for key, exp in full.items():
+        np.testing.assert_array_equal(host[key], exp, err_msg=str(key))
+
+
+def test_region_tier1_work_is_windowed(rng):
+    """Region coefficient reads must not pay full-image Tier-1: the
+    block counter shows a small fraction for a small window (the PR 6
+    property, inherited through the shared windowed fill)."""
+    from bucketeer_tpu.server.metrics import Metrics
+
+    from bucketeer_tpu.codec import decode as codec_decode
+
+    _, data = _encode(rng, (384, 384), levels=2, gen_plt=True)
+    idx = build_index(data)
+    sink = Metrics()
+    codec_decode.set_metrics_sink(sink)
+    try:
+        decode_to_coefficients(data)
+        full_blocks = sink.report()["counters"]["decode.blocks"]
+        sink2 = Metrics()
+        codec_decode.set_metrics_sink(sink2)
+        decode_to_coefficients(data, region=(0, 0, 32, 32), index=idx)
+        win_counters = sink2.report()["counters"]
+    finally:
+        codec_decode.set_metrics_sink(None)
+    assert win_counters["decode.region_blocks"] < full_blocks / 2
+    assert win_counters["decode.coeff_requests"] == 1
+
+
+def test_invalid_params_typed(rng):
+    _, data = _encode(rng, (64, 64), levels=2)
+    with pytest.raises(InvalidParam):
+        decode_to_coefficients(data, reduce=7)
+    with pytest.raises(InvalidParam):
+        decode_to_coefficients(data, reduce=-1)
+    with pytest.raises(InvalidParam):
+        decode_to_coefficients(data, layers=0)
+    for bad in ((0, 0, 0, 5), (-1, 0, 5, 5), (999, 0, 5, 5),
+                ("a", 0, 5, 5), (1.5, 0, 5, 5)):
+        with pytest.raises(InvalidParam):
+            decode_to_coefficients(data, region=bad)
+
+
+# --- reader integration: the tiered cache gains a coefficients key -------
+
+class _CountingScheduler:
+    def __init__(self):
+        self.reads = 0
+
+    def read(self, fn, *a, **kw):
+        self.reads += 1
+        return fn(*a, **kw)
+
+
+def test_reader_coefficient_cache(rng, tmp_path):
+    from bucketeer_tpu.converters.reader import TpuReader
+    from bucketeer_tpu.server.metrics import Metrics
+
+    img, data = _encode(rng, (96, 96), gen_plt=True)
+    path = tmp_path / "c.jp2"
+    path.write_bytes(data)
+    sink = Metrics()
+    sched = _CountingScheduler()
+    reader = TpuReader(cache_mb=8, metrics=sink, scheduler=sched)
+
+    cs1 = reader.read_coefficients(str(path))
+    cs2 = reader.read_coefficients(str(path))
+    assert cs2 is cs1                       # decoded-tile tier hit
+    assert sched.reads == 1                 # miss was admitted once
+    counters = sink.report()["counters"]
+    assert counters["decode.cache_hits"] == 1
+    assert counters["decode.cache_misses"] == 1
+
+    # The coefficients=True key dimension: a pixel read of the same
+    # (path, reduce, layers, region) is a distinct entry, not a hit.
+    reader.read(str(path))
+    counters = sink.report()["counters"]
+    assert counters["decode.cache_misses"] == 2
+
+    # Region reads share the stream-index tier with pixel reads.
+    r1 = reader.read_coefficients(str(path), region=(8, 8, 32, 32))
+    r2 = reader.read_coefficients(str(path), region=(8, 8, 32, 32))
+    assert r2 is r1
+    counters = sink.report()["counters"]
+    assert counters["decode.index_cache_misses"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(r1.bands[(0, "LL")]),
+        np.asarray(cs1.bands[(0, "LL")])[
+            :, r1.windows[(0, "LL")][0]:r1.windows[(0, "LL")][1],
+            r1.windows[(0, "LL")][2]:r1.windows[(0, "LL")][3]])
+
+
+def test_decode_cache_holds_coefficient_sets(rng):
+    """CoefficientSets participate in the byte-budgeted LRU exactly
+    like arrays: sized by nbytes, evicted in LRU order (their bands
+    are immutable jax arrays, so no write lock applies)."""
+    from bucketeer_tpu.converters.reader import _DecodeCache
+
+    _, data = _encode(rng, (64, 64))
+    cs = decode_to_coefficients(data)
+    cache = _DecodeCache(max_bytes=3 * cs.nbytes + 16)
+    for k in range(4):
+        cache.put(("coeffs", k), cs)
+    assert cache.evictions == 1
+    assert cache.get(("coeffs", 0)) is None
+    assert cache.get(("coeffs", 3)) is cs
+    assert cache.nbytes <= cache.max_bytes

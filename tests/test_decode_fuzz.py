@@ -124,3 +124,50 @@ def test_valid_stream_still_decodes(valid_stream):
     """Guard the fixture itself: the unmutated stream round-trips."""
     img, data = valid_stream
     np.testing.assert_array_equal(decode(data), img)
+
+
+# --- decode_to_coefficients: the same trust boundary (ISSUE 13) ----------
+
+def _try_coeffs(data: bytes, **kw):
+    from bucketeer_tpu.tensor import (CoefficientSet,
+                                      decode_to_coefficients)
+
+    try:
+        out = decode_to_coefficients(data, **kw)
+        assert isinstance(out, CoefficientSet)
+        return out
+    except DecodeError:
+        return None
+
+
+def test_coefficients_empty_and_garbage():
+    from bucketeer_tpu.tensor import decode_to_coefficients
+
+    for junk in (b"", b"\x00", b"not a jp2 at all", b"\xff" * 64,
+                 bytes(range(256))):
+        with pytest.raises(DecodeError):
+            decode_to_coefficients(junk)
+    with pytest.raises(TypeError):
+        decode_to_coefficients(12345)
+
+
+def test_coefficients_truncated_prefixes(valid_stream):
+    _, data = valid_stream
+    rng = np.random.default_rng(17)
+    cuts = sorted(set(rng.integers(0, len(data) - 1, size=30).tolist())
+                  | {0, 1, 12, len(data) // 2, len(data) - 1})
+    assert all(_try_coeffs(data[:cut]) is None for cut in cuts)
+
+
+def test_coefficients_bit_flips(valid_stream):
+    """Single-bit corruption: a coefficient read either still parses
+    (a flipped coefficient bit) or raises the typed DecodeError — the
+    raw-IndexError class of escape is the bug being fenced."""
+    _, data = valid_stream
+    rng = np.random.default_rng(19)
+    for _ in range(60):
+        pos = int(rng.integers(0, len(data)))
+        mutated = bytearray(data)
+        mutated[pos] ^= 1 << int(rng.integers(0, 8))
+        _try_coeffs(bytes(mutated))
+        _try_coeffs(bytes(mutated), region=(4, 4, 16, 16))

@@ -1,0 +1,191 @@
+"""The tensor-delivery HTTP surface (ISSUE 13): GET
+/images/{id}/coefficients (npz of subband planes + X-Coeff-Meta),
+POST/GET /tensors/{id} (npy in, container stored, npy/blob out,
+progressive planes=), typed 400s, and the 503 + Retry-After admission
+ladder shared with every other endpoint.
+"""
+import io
+import json
+
+import numpy as np
+import pytest
+
+from bucketeer_tpu import config as cfg
+from bucketeer_tpu import features
+from bucketeer_tpu.codec import encoder as codec_encoder
+from bucketeer_tpu.codec.encoder import EncodeParams
+from bucketeer_tpu.converters import output_path
+from bucketeer_tpu.engine import Engine, FakeS3Client, RecordingSlackClient
+from bucketeer_tpu.server.app import build_app
+
+
+@pytest.fixture
+def env_client(tmp_path, aiohttp_client):
+    async def factory():
+        config = cfg.Config.load(overrides={
+            cfg.IIIF_URL: "http://iiif.test/iiif",
+            cfg.SLACK_CHANNEL_ID: "chan",
+            cfg.FILESYSTEM_CSV_MOUNT: str(tmp_path / "csv-mount"),
+        })
+        engine = Engine(
+            config,
+            flags=features.FeatureFlagChecker(static={}),
+            converter=None,
+            s3_client=FakeS3Client(str(tmp_path / "s3")),
+            slack_client=RecordingSlackClient())
+        app = build_app(engine, job_delete_timeout=0.1)
+        client = await aiohttp_client(app)
+        return client, engine
+
+    return factory
+
+
+def _write_derivative(tmp_path, monkeypatch, image_id="coeff-img",
+                      size=64):
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    rng = np.random.default_rng(23)
+    img = rng.integers(0, 256, size=(size, size, 3)).astype(np.uint8)
+    data = codec_encoder.encode_jp2(
+        img, 8, EncodeParams(lossless=True, levels=2, tile_size=size,
+                             gen_plt=True), jpx=True)
+    with open(output_path(image_id, ".jpx"), "wb") as fh:
+        fh.write(data)
+    return img, data
+
+
+async def test_get_coefficients(tmp_path, env_client, monkeypatch):
+    from bucketeer_tpu.tensor import decode_to_coefficients
+
+    _, data = _write_derivative(tmp_path, monkeypatch)
+    client, _ = await env_client()
+    resp = await client.get("/images/coeff-img/coefficients")
+    assert resp.status == 200
+    meta = json.loads(resp.headers["X-Coeff-Meta"])
+    assert meta["levels"] == 2 and meta["reversible"] is True
+    with np.load(io.BytesIO(await resp.read())) as npz:
+        got = dict(npz)
+    expected = decode_to_coefficients(data).to_host()
+    assert set(got) == {f"r{r}_{n}" for r, n in expected}
+    for (r, n), arr in expected.items():
+        np.testing.assert_array_equal(got[f"r{r}_{n}"], arr)
+
+    # Region read: windows in the meta, windowed arrays in the npz.
+    resp = await client.get(
+        "/images/coeff-img/coefficients?region=8,8,32,32")
+    assert resp.status == 200
+    meta = json.loads(resp.headers["X-Coeff-Meta"])
+    assert "windows" in meta
+    with np.load(io.BytesIO(await resp.read())) as npz:
+        for key, win in meta["windows"].items():
+            np.testing.assert_array_equal(
+                npz[key],
+                expected[_unkey(key)][:, win[0]:win[1], win[2]:win[3]])
+
+
+def _unkey(key: str):
+    res, name = key.split("_")
+    return (int(res[1:]), name)
+
+
+async def test_get_coefficients_errors(tmp_path, env_client,
+                                       monkeypatch):
+    _write_derivative(tmp_path, monkeypatch)
+    client, _ = await env_client()
+    assert (await client.get(
+        "/images/no-such/coefficients")).status == 404
+    assert (await client.get(
+        "/images/coeff-img/coefficients?reduce=-1")).status == 400
+    assert (await client.get(
+        "/images/coeff-img/coefficients?reduce=9")).status == 400
+    assert (await client.get(
+        "/images/coeff-img/coefficients?region=1,2,3")).status == 400
+    assert (await client.get(
+        "/images/coeff-img/coefficients?region=0,0,0,5")).status == 400
+
+
+async def test_tensor_post_get_roundtrip(tmp_path, env_client,
+                                         monkeypatch):
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    # Host backend over HTTP: the endpoint's job is plumbing, the
+    # backend equivalence is the codec suite's job.
+    monkeypatch.setenv("BUCKETEER_TENSOR_BACKEND", "host")
+    client, _ = await env_client()
+    rng = np.random.default_rng(29)
+    arr = rng.standard_normal((40, 30)).astype(np.float32)
+    buf = io.BytesIO()
+    np.save(buf, arr)
+
+    resp = await client.post("/tensors/ckpt%2Flayer0",
+                             data=buf.getvalue())
+    assert resp.status == 201
+    stats = await resp.json()
+    assert stats["tensor-id"] == "ckpt/layer0"
+    assert stats["dtype"] == "float32"
+    assert stats["shape"] == [40, 30]
+    assert stats["coded_bytes"] > 0
+
+    resp = await client.get("/tensors/ckpt%2Flayer0")
+    assert resp.status == 200
+    assert resp.headers["X-Tensor-Dtype"] == "float32"
+    got = np.load(io.BytesIO(await resp.read()))
+    np.testing.assert_array_equal(got.view(np.uint32),
+                                  arr.view(np.uint32))
+
+    # Progressive: planes= truncation over HTTP, and the raw blob.
+    resp = await client.get("/tensors/ckpt%2Flayer0?planes=8")
+    assert resp.status == 200
+    approx = np.load(io.BytesIO(await resp.read()))
+    assert approx.shape == arr.shape
+    resp = await client.get("/tensors/ckpt%2Flayer0?format=blob")
+    assert resp.status == 200
+    blob = await resp.read()
+    from bucketeer_tpu.tensor import decode_tensor
+    np.testing.assert_array_equal(
+        decode_tensor(blob).view(np.uint32), arr.view(np.uint32))
+
+    metrics = await (await client.get("/metrics")).json()
+    counters = metrics["counters"]
+    assert counters["tensor.encode_requests"] == 1
+    assert counters["tensor.decode_requests"] >= 2
+    assert "tensor.encode" in metrics["stages"]
+
+
+async def test_tensor_errors(tmp_path, env_client, monkeypatch):
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    client, _ = await env_client()
+    assert (await client.get("/tensors/none")).status == 404
+    assert (await client.post("/tensors/x", data=b"")).status == 400
+    assert (await client.post("/tensors/x",
+                              data=b"not an npy")).status == 400
+    # Unsupported dtype inside a valid npy -> 400, not 500.
+    buf = io.BytesIO()
+    np.save(buf, np.zeros(4, dtype=np.complex64))
+    assert (await client.post("/tensors/x",
+                              data=buf.getvalue())).status == 400
+    buf = io.BytesIO()
+    np.save(buf, np.zeros(4, dtype=np.int8))
+    assert (await client.post("/tensors/x?planes=zzz",
+                              data=buf.getvalue())).status == 400
+
+
+async def test_tensor_admission_503(tmp_path, env_client, monkeypatch):
+    """QueueFull from the shared scheduler surfaces as 503 +
+    Retry-After on the tensor endpoints, the same ladder as every
+    other admitted kind (forced via the graftgremlin injection point,
+    like the ingest suite does)."""
+    from bucketeer_tpu.engine import faults
+    from bucketeer_tpu.engine.scheduler import QueueFull
+
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    client, _ = await env_client()
+    buf = io.BytesIO()
+    np.save(buf, np.zeros(8, dtype=np.int8))
+
+    faults.install(faults.FaultPlan().at(
+        "sched.submit", lambda: QueueFull(1, 2.5, "tensor"), times=1))
+    try:
+        resp = await client.post("/tensors/busy", data=buf.getvalue())
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+    finally:
+        faults.install(None)
