@@ -225,6 +225,31 @@ def _stage_profile(sink, prefixes=("encode.", "decode.")) -> dict:
     return out
 
 
+def _stage_percentiles(sink, prefixes=("encode.", "decode.")) -> dict:
+    """Server-side p50/p95/p99 per stage from the sink's log2-bucket
+    histograms (ISSUE 14) — the bench JSON twin of ``stage_profile``,
+    so tail behavior ships next to the throughput split."""
+    out = {}
+    for name, st in sink.report()["stages"].items():
+        if not name.startswith(tuple(prefixes)):
+            continue
+        if "p95_ms" not in st:
+            continue
+        out[name] = {k: st[k] for k in ("p50_ms", "p95_ms", "p99_ms")}
+    return out
+
+
+def _assert_p95_agreement(server_ms, client_ms, context: str) -> None:
+    """Server-side histograms must agree with client-observed
+    percentiles: quarter-octave buckets bound quantization at ~19%,
+    the rest of the window covers sampling noise on smoke-sized runs
+    plus the client's extra thread-scheduling overhead."""
+    assert server_ms is not None, f"{context}: no server-side histogram"
+    assert abs(server_ms - client_ms) <= 0.5 * client_ms + 10.0, (
+        f"{context}: server-side p95 {server_ms:.1f} ms disagrees with "
+        f"client-side {client_ms:.1f} ms beyond tolerance")
+
+
 # --- configs -------------------------------------------------------------
 
 # The three Tier-1 modes the split compares: legacy host Tier-1 over
@@ -699,7 +724,6 @@ def config7_concurrent_serving(repeats: int) -> dict:
                             queue_depth=2 * n_clients,
                             window_s=window_s)
     sink = Metrics()
-    sched.set_metrics_sink(sink)
 
     def round_trip() -> tuple:
         outs = [[None] * per_client for _ in range(n_clients)]
@@ -732,9 +756,15 @@ def config7_concurrent_serving(repeats: int) -> dict:
         return time.perf_counter() - w0, outs, lats
 
     round_trip()                 # warm the merged-bucket compiles
+    # Sink attached after warmup: the server-side request histogram
+    # then covers exactly the measured rounds, so its p95 is
+    # comparable 1:1 with the client-side all-round percentile.
+    sched.set_metrics_sink(sink)
     best, outs, lats = None, None, None
+    all_lats: list = []
     for _ in range(max(repeats, 3)):
         wall, o, l = round_trip()
+        all_lats.extend(l)
         if best is None or wall < best:
             best, outs, lats = wall, o, l
     try:
@@ -746,6 +776,16 @@ def config7_concurrent_serving(repeats: int) -> dict:
         qw = rep["stages"].get("encode.queue_wait", {})
         flat_out = [o for client_outs in outs for o in client_outs]
         mpix = len(flat) * size * size / 1e6
+        # ISSUE 14 gate: the new server-side request-latency histogram
+        # (encode.request, /metrics p95) must agree with what clients
+        # actually measured across the same rounds.
+        all_ms = sorted(x * 1e3 for x in all_lats)
+        client_p95_ms = all_ms[min(len(all_ms) - 1,
+                                   int(len(all_ms) * 0.95))]
+        server_p95_ms = rep["stages"].get("encode.request",
+                                          {}).get("p95_ms")
+        _assert_p95_agreement(server_p95_ms, client_p95_ms,
+                              "7_concurrent_serving")
         return {
             "value": round(mpix / best, 3), "unit": "MPix/s",
             "seconds": round(best, 3), "clients": n_clients,
@@ -765,6 +805,9 @@ def config7_concurrent_serving(repeats: int) -> dict:
                                               0),
             "byte_identical": all(a == b
                                   for a, b in zip(serial, flat_out)),
+            "server_p95_ms": round(server_p95_ms, 1),
+            "client_p95_all_rounds_ms": round(client_p95_ms, 1),
+            "stage_percentiles": _stage_percentiles(sink, ("encode.",)),
             "repeats": repeats,
         }
     finally:
@@ -888,6 +931,13 @@ def config8_tile_storm(repeats: int) -> dict:
             warm = run_phase()
             rep = sink2.report()
             counters = rep.get("counters", {})
+            # ISSUE 14 gate: server-side decode.request p95 (histogram;
+            # only cache misses reach the scheduler, so it covers
+            # exactly the cold phase) vs the cold clients' own p95.
+            server_p95_ms = rep["stages"].get("decode.request",
+                                              {}).get("p95_ms")
+            _assert_p95_agreement(server_p95_ms, cold["p95_ms"],
+                                  "8_tile_storm")
         finally:
             set_metrics_sink(None)
             sched.close()
@@ -914,7 +964,9 @@ def config8_tile_storm(repeats: int) -> dict:
             "index_misses": counters.get("decode.index_cache_misses", 0),
         },
         "admission_rejects": counters.get("decode.admission_rejects", 0),
+        "server_p95_ms": round(server_p95_ms, 1),
         "stage_profile": _stage_profile(sink2, ("decode.",)),
+        "stage_percentiles": _stage_percentiles(sink2, ("decode.",)),
         "repeats": repeats,
     }
 

@@ -61,13 +61,26 @@ class Ctl:
 
 def run_schedule(scenario_fn, strategy, max_steps: int = 50000
                  ) -> TraceRuntime:
-    """Execute one controlled run of a scenario under ``strategy``."""
+    """Execute one controlled run of a scenario under ``strategy``.
+
+    Any graftscope recorder installed by the surrounding process (a
+    test that booted the server, say) is parked for the run: its locks
+    were created *before* the runtime, so a controlled thread holding
+    one across a yield point would block its sibling for real — a
+    hang the explorer cannot model. Scenarios that want tracing under
+    exploration install their own recorder inside the run, whose seam
+    locks are controlled."""
+    from ... import obs
+
     rt = TraceRuntime(strategy, RaceDetector(), max_steps)
+    prev_rec = obs.get_recorder()
+    obs.install(None)
     seam.install(rt)
     try:
         rt.run(lambda: scenario_fn(Ctl(rt)))
     finally:
         seam.install(None)
+        obs.install(prev_rec)
     return rt
 
 

@@ -259,7 +259,7 @@ def run_lint(root: Path, baseline: set | None = None,
     baseline to report (and ``--prune-baseline`` to drop) stale
     entries."""
     from . import abi, rules_async, rules_donation, rules_hygiene, \
-        rules_jax, rules_lockorder, rules_locks
+        rules_jax, rules_lockorder, rules_locks, rules_obs
 
     project = load_project(Path(root))
     findings: list = []
@@ -275,6 +275,7 @@ def run_lint(root: Path, baseline: set | None = None,
     findings += rules_donation.run(project)
     findings += rules_locks.run(project)
     findings += rules_lockorder.run(project)
+    findings += rules_obs.run(project)
     if native_dir is None:
         candidate = Path(root) / "native"
         native_dir = candidate if candidate.is_dir() else None

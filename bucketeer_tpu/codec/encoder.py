@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .. import obs
 from ..analysis.contracts import contract
 from ..config import truthy as cfg_truthy
 from . import codestream as cs
@@ -884,29 +885,35 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     def dispatch(chunk: _Chunk) -> None:
         check_deadline()
         t0 = time.perf_counter()
-        batch = np.stack([img[y0:y0 + chunk.plan.tile_h,
-                              x0:x0 + chunk.plan.tile_w]
-                          for _, y0, x0 in chunk.members])
-        mode = "mq" if use_mq else ("cxd" if use_cxd else "rows")
-        chunk.pending = dispatch_fn(chunk.plan, batch, mode=mode)
+        with obs.span("encode.dispatch", tiles=len(chunk.members)):
+            batch = np.stack([img[y0:y0 + chunk.plan.tile_h,
+                                  x0:x0 + chunk.plan.tile_w]
+                              for _, y0, x0 in chunk.members])
+            mode = "mq" if use_mq else ("cxd" if use_cxd else "rows")
+            chunk.pending = dispatch_fn(chunk.plan, batch, mode=mode)
         _tm_add("device", time.perf_counter() - t0)
 
     def resolve(chunk: _Chunk) -> None:
         t0 = time.perf_counter()
-        chunk.fres = chunk.pending.resolve_stats()
+        with obs.span("encode.resolve_stats"):
+            chunk.fres = chunk.pending.resolve_stats()
         chunk.pending = None
         _tm_add("device", time.perf_counter() - t0)
 
     def host_code(chunk: _Chunk, floors: np.ndarray, payload: np.ndarray,
                   offsets: np.ndarray) -> list:
         """Runs on the bounded worker; native Tier-1 releases the GIL,
-        so this overlaps the caller's device dispatch/waits."""
+        so this overlaps the caller's device dispatch/waits. Submitted
+        through obs.bind so the pool thread re-enters the request's
+        trace context (host Tier-1 items show in the span tree)."""
         t0 = time.perf_counter()
-        blocks = t1_batch.encode_packed(payload, offsets, chunk.fres.nbps,
-                                        floors, chunk.hs, chunk.ws,
-                                        chunk.bandnames)
-        if not params.lossless:
-            _correct_distortions(blocks, chunk.fres)
+        with obs.span("encode.host_t1", blocks=len(chunk.dests)):
+            blocks = t1_batch.encode_packed(payload, offsets,
+                                            chunk.fres.nbps,
+                                            floors, chunk.hs, chunk.ws,
+                                            chunk.bandnames)
+            if not params.lossless:
+                _correct_distortions(blocks, chunk.fres)
         _tm_add("host", time.perf_counter() - t0)
         return blocks
 
@@ -914,9 +921,10 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         """The CX/D-mode host half: pure MQ replay of the device's
         symbol streams — no context modeling left on the host."""
         t0 = time.perf_counter()
-        blocks = t1_batch.encode_cxd(streams)
-        if not params.lossless:
-            _correct_distortions(blocks, chunk.fres)
+        with obs.span("encode.mq_replay", blocks=len(chunk.dests)):
+            blocks = t1_batch.encode_cxd(streams)
+            if not params.lossless:
+                _correct_distortions(blocks, chunk.fres)
         dt = time.perf_counter() - t0
         _tm_add("host", dt)
         _tm_add("mq", dt)
@@ -930,10 +938,11 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
             # the MQ coder back to back (symbols stay in HBM between
             # the two programs) and ships finished byte segments; the
             # shared host Tier-1 pool is bypassed entirely.
-            res = cxd_mod.run_device_mq(
-                chunk.fres.blocks, chunk.fres.nbps, floors,
-                chunk.bandnames, chunk.hs, chunk.ws,
-                chunk.fres.layout.P, frac_bits)
+            with obs.span("encode.t1_device", blocks=len(chunk.dests)):
+                res = cxd_mod.run_device_mq(
+                    chunk.fres.blocks, chunk.fres.nbps, floors,
+                    chunk.bandnames, chunk.hs, chunk.ws,
+                    chunk.fres.layout.P, frac_bits)
             _tm_add("device", res.cxd_s + res.mq_s)
             _tm_add("cxd", res.cxd_s)
             _tm_add("mq_dev", res.mq_s)
@@ -952,10 +961,12 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
             futs.append(_ImmediateResult(blocks))
             return
         if use_cxd:
-            streams = cxd_mod.run_cxd(
-                chunk.fres.blocks, chunk.fres.nbps, floors,
-                chunk.bandnames, chunk.hs, chunk.ws,
-                chunk.fres.layout.P, frac_bits)
+            with obs.span("encode.cxd_device",
+                          blocks=len(chunk.dests)):
+                streams = cxd_mod.run_cxd(
+                    chunk.fres.blocks, chunk.fres.nbps, floors,
+                    chunk.bandnames, chunk.hs, chunk.ws,
+                    chunk.fres.layout.P, frac_bits)
             dt = time.perf_counter() - t0
             _tm_add("device", dt)
             _tm_add("cxd", dt)
@@ -974,11 +985,14 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
         live = [f for f in futs if not f.done()]
         if len(live) > HOST_QUEUE_DEPTH:
             live[0].result()
+        # obs.bind: the shared pool's threads don't inherit contextvars;
+        # rebind the request's trace context around the host-coding item.
         if use_cxd:
-            futs.append(pool.submit(host_replay, chunk, streams))
+            futs.append(pool.submit(obs.bind(host_replay), chunk,
+                                    streams))
         else:
-            futs.append(pool.submit(host_code, chunk, floors, payload,
-                                    offsets))
+            futs.append(pool.submit(obs.bind(host_code), chunk, floors,
+                                    payload, offsets))
 
     def chunk_floors(margin: float) -> list:
         if target is None:
@@ -1116,17 +1130,18 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
     all_coded: list = []
     block_weights: list = []
     assign_index: dict = {}     # id(CodedBlock) -> index
-    for chunk, blocks in zip(chunks, blocks_by_chunk):
-        for (band, cy, cx), blk, bw in zip(chunk.dests, blocks,
-                                           chunk.wts):
-            assert blk.n_bitplanes <= band.q.n_bitplanes, (
-                f"block bitplanes {blk.n_bitplanes} exceed Mb "
-                f"{band.q.n_bitplanes} in {band.name}")
-            band.blocks[(cy, cx)] = blk
-            assign_index[id(blk)] = len(all_coded)
-            all_coded.append(blk)
-            block_weights.append(bw)
-        chunk.fres = None         # release stats + any remaining rows
+    with obs.span("encode.reassemble", chunks=len(chunks)):
+        for chunk, blocks in zip(chunks, blocks_by_chunk):
+            for (band, cy, cx), blk, bw in zip(chunk.dests, blocks,
+                                               chunk.wts):
+                assert blk.n_bitplanes <= band.q.n_bitplanes, (
+                    f"block bitplanes {blk.n_bitplanes} exceed Mb "
+                    f"{band.q.n_bitplanes} in {band.name}")
+                band.blocks[(cy, cx)] = blk
+                assign_index[id(blk)] = len(all_coded)
+                all_coded.append(blk)
+                block_weights.append(bw)
+            chunk.fres = None     # release stats + any remaining rows
     return _finish(img, params, tile_records, all_coded, block_weights,
                    assign_index, qcd_values, used_mct, bitdepth, n_comps,
                    levels, tile, target)
@@ -1139,6 +1154,19 @@ def _finish(img: np.ndarray, params: EncodeParams, tile_records: list,
     """PCRD layer allocation + Tier-2 + codestream assembly, iterated a
     few times so the assembled file size (headers included) lands on the
     byte target."""
+    with obs.span("encode.tier2"):
+        return _finish_spanned(img, params, tile_records, all_blocks,
+                               block_weights, assign_index, qcd_values,
+                               used_mct, bitdepth, n_comps, levels,
+                               tile, target)
+
+
+def _finish_spanned(img: np.ndarray, params: EncodeParams,
+                    tile_records: list, all_blocks: list,
+                    block_weights: list, assign_index: dict,
+                    qcd_values: list, used_mct: bool, bitdepth: int,
+                    n_comps: int, levels: int, tile: int,
+                    target: float | None) -> bytes:
     h, w = img.shape[:2]
     exps = _precinct_exps(params, levels)
     segs = [

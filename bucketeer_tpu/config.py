@@ -82,6 +82,14 @@ SCHED_DEADLINE_S = "bucketeer.sched.deadline.s"
 # (converters/reader.py; 0 disables). Env analog by the standard
 # overlay: BUCKETEER_DECODE_CACHE_MB.
 DECODE_CACHE_MB = "bucketeer.decode.cache.mb"
+# graftscope (bucketeer_tpu/obs): per-endpoint latency SLO spec, e.g.
+# "default=500,get_image=250" in milliseconds per endpoint (the
+# handler name labelling /metrics' http.* stages); a breach
+# bumps slo.breach.* counters and freezes the flight recorder. Empty
+# disables the watchdog. Env analog: BUCKETEER_SLO. (Tracing itself is
+# gated by BUCKETEER_TRACE, default on; ring size by
+# BUCKETEER_TRACE_RING.)
+SLO = "bucketeer.slo"
 # Durable job store (engine/journal.py): when set, the JobStore keeps a
 # write-ahead journal + snapshot in this directory so killed processes
 # resume their batch jobs on restart. Absent/empty keeps the in-memory

@@ -28,6 +28,11 @@ NOTHING_PROCESSED = "nothing-processed"
 # Admission backpressure: seconds-to-wait hint carried in a 503 reply
 # body (engine/scheduler.py QueueFull -> HTTP Retry-After header).
 RETRY_AFTER = "retry-after"
+# graftscope trace context carried on bus messages: batch-item and S3
+# messages are consumed in fresh asyncio tasks (no contextvar
+# inheritance), so the request id rides the payload and the consumer
+# re-enters it (bucketeer_tpu/obs).
+REQUEST_ID = "request-id"
 # Per-job dead-letter records in the GET /batch/jobs/{name} detail
 # (engine/retry.py DeadLetterLog — items that exhausted their budget).
 DEAD_LETTERS = "dead-letters"

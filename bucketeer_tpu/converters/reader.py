@@ -39,6 +39,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from .. import obs
 from ..analysis.graftrace import seam
 from ..codec.decode import DecodeError, build_index, decode
 from ..codec.decode import probe as _probe
@@ -367,8 +368,13 @@ class TpuReader:
                     index=idx)
             return decode(data, reduce=reduce, layers=layers,
                           region=region, index=idx)
-        out = (self.scheduler.read(job) if self.scheduler is not None
-               else job())
+        if self.scheduler is not None:
+            with obs.span("decode.read",
+                          region=list(region) if region else None,
+                          reduce=reduce):
+                out = self.scheduler.read(job)
+        else:
+            out = job()
         if self.cache is not None:
             evicted = self.cache.put(key, out)
             if evicted and self.metrics is not None:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import os
 
+from .. import obs
 from ..codec import tiff
 from ..codec.encoder import EncodeParams, encode_jp2
 from .base import Conversion, ConverterError, output_path
@@ -205,11 +206,13 @@ class TpuConverter:
                      image_id, w, h, dict(mesh.shape))
         sched = self.scheduler or sched_mod.get_scheduler()
         try:
-            data = sched.encode_jp2(
-                img, bitdepth, params, jpx=self.jpx, mesh=mesh,
-                priority=(sched_mod.PRIORITY_SINGLE if priority is None
-                          else priority),
-                deadline_s=deadline_s)
+            with obs.span("convert.encode", image_id=image_id,
+                          pixels=h * w):
+                data = sched.encode_jp2(
+                    img, bitdepth, params, jpx=self.jpx, mesh=mesh,
+                    priority=(sched_mod.PRIORITY_SINGLE
+                              if priority is None else priority),
+                    deadline_s=deadline_s)
         except (sched_mod.QueueFull, sched_mod.DeadlineExceeded):
             # Admission/deadline outcomes are protocol, not converter
             # failures: the HTTP layer maps them to 503 + Retry-After.

@@ -23,6 +23,7 @@ import random
 from .. import config as cfg
 from .. import constants as c
 from .. import features
+from .. import obs
 from ..converters import Conversion, ConverterError
 from ..models import Job, WorkflowState
 from . import faults
@@ -91,6 +92,16 @@ class BatchConverterWorker:
         bus.consumer(BATCH_CONVERTER, self.handle, instances=instances)
 
     async def handle(self, message: dict) -> Reply:
+        # Bus consumers run in fresh tasks: re-enter the originating
+        # request's trace context from the message so the item's spans
+        # and log lines carry the CSV upload's request id.
+        with obs.request_context(message.get(c.REQUEST_ID)):
+            with obs.span("batch.item",
+                          image_id=message[c.IMAGE_ID],
+                          job=message[c.JOB_NAME]):
+                return await self._handle_item(message)
+
+    async def _handle_item(self, message: dict) -> Reply:
         job_name = message[c.JOB_NAME]
         image_id = message[c.IMAGE_ID]
         file_path = message[c.FILE_PATH]
@@ -117,6 +128,7 @@ class BatchConverterWorker:
                 c.FILE_PATH: derivative,
                 c.JOB_NAME: job_name,
                 c.DERIVATIVE_IMAGE: True,
+                c.REQUEST_ID: message.get(c.REQUEST_ID),
             })
             ok = reply.is_success
             if self.counters is not None:
@@ -204,6 +216,10 @@ async def start_job(job: Job, bus: MessageBus, config,
     lambda_mode = (config.get_str(BATCH_MODE) or "tpu").lower() == "lambda"
     large_ok = flags.is_enabled(features.LARGE_IMAGES)
     dispatched = 0
+    # The CSV upload's trace context (start_job runs in a task created
+    # from the handler, so contextvars carried it here); stamped on
+    # every dispatched item so the batch converter can re-enter it.
+    request_id = obs.current_request_id()
 
     async def _mark(item_id: str) -> None:
         if store is not None:
@@ -254,6 +270,8 @@ async def start_job(job: Job, bus: MessageBus, config,
                        c.FILE_PATH: path}
                 if conversion:
                     msg[c.CONVERSION_TYPE] = conversion
+                if request_id:
+                    msg[c.REQUEST_ID] = request_id
                 await _mark(item.id)
                 await bus.send(BATCH_CONVERTER, msg)
             dispatched += 1

@@ -32,6 +32,7 @@ import logging
 import os
 import threading
 
+from .. import obs
 from ..models import Job, WorkflowState
 from . import faults
 from .retry import count_metric as _count
@@ -83,7 +84,8 @@ class JobJournal:
         the disk doesn't have)."""
         try:
             faults.point("journal.write", op=record.get("op", ""))
-            with self._lock:
+            with obs.span("journal.write", op=record.get("op", "")), \
+                    self._lock:
                 fh = self._handle_locked()
                 fh.write(json.dumps(record, separators=(",", ":"))
                          + "\n")

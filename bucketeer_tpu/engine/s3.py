@@ -36,6 +36,7 @@ import urllib.parse
 from dataclasses import dataclass
 
 from .. import constants as c
+from .. import obs
 from .. import op
 from . import faults
 from .bus import MessageBus, Reply
@@ -232,6 +233,12 @@ class S3UploadWorker:
         return None
 
     async def handle(self, message: dict) -> Reply:
+        # Trace context rides the message (consumers run in fresh
+        # tasks); the store op shows in the originating request's tree.
+        with obs.request_context(message.get(c.REQUEST_ID)):
+            return await self._handle_put(message)
+
+    async def _handle_put(self, message: dict) -> Reply:
         image_id = message[c.IMAGE_ID]
         file_path = message[c.FILE_PATH]
         job_name = message.get(c.JOB_NAME)
@@ -258,7 +265,9 @@ class S3UploadWorker:
             metadata[c.JOB_NAME] = job_name
         try:
             faults.point("s3.put", image_id=image_id, bucket=bucket)
-            await self.client.put(bucket, image_id, file_path, metadata)
+            with obs.span("s3.put", image_id=image_id, bucket=bucket):
+                await self.client.put(bucket, image_id, file_path,
+                                      metadata)
         except Exception as exc:
             status = self._retryable_status(exc)
             if self.breaker is not None:

@@ -71,7 +71,9 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .. import obs
 from ..analysis.graftrace import seam
+from ..obs import cost as obs_cost
 from . import faults
 
 LOG = logging.getLogger(__name__)
@@ -133,11 +135,15 @@ class _Ticket:
 
 @dataclass
 class _DeviceJob:
-    """One chunk's front-end launch request."""
+    """One chunk's front-end launch request. ``ctx`` is the submitting
+    request's graftscope span context, captured on the request thread
+    (the device thread has none): the merged launch span *links* every
+    request whose chunks it batched through these."""
     plan: object
     tiles: np.ndarray
     mode: str
     n_tiles: int
+    ctx: object = None
     event: threading.Event = field(
         default_factory=lambda: seam.make_event("DeviceJob.event"))
     result: object = None
@@ -399,8 +405,13 @@ class EncodeScheduler:
                 raise DeadlineExceeded(
                     f"{ticket.kind} deadline expired mid-pipeline")
 
+        # The whole admitted request is one latency sample: the
+        # per-kind histogram behind /metrics' server-side p50/p95/p99
+        # (bench configs 7/8 assert it against client-side timing).
+        t_req = time.perf_counter()
         try:
-            self._await_slot(ticket)
+            with obs.span(f"{kind}.queue_wait", priority=priority):
+                self._await_slot(ticket)
             if kind == "tensor":
                 from ..tensor import tensor_services
                 with tensor_services(check=check):
@@ -415,6 +426,9 @@ class EncodeScheduler:
                 return fn(*args, **kwargs)
         finally:
             self._finish(ticket)
+            if self._sink is not None:
+                self._sink.record(f"{kind}.request",
+                                  time.perf_counter() - t_req)
 
     def read(self, fn, *args, priority: int = PRIORITY_READ,
              deadline_s: float | None = None, **kwargs):
@@ -471,7 +485,8 @@ class EncodeScheduler:
         :class:`SchedulerClosed` (never hangs) once :meth:`close` has
         run."""
         self._ensure_device_thread()
-        job = _DeviceJob(plan, np.asarray(tiles), mode, len(tiles))
+        job = _DeviceJob(plan, np.asarray(tiles), mode, len(tiles),
+                         ctx=obs.current_context())
         with self._dq_cv:
             seam.read(self, "_stop")
             if self._stop:
@@ -599,33 +614,66 @@ class EncodeScheduler:
             from ..codec import frontend
             launch = frontend.dispatch_frontend
 
+        # The merged launch belongs to no single request: it gets an
+        # unparented span *linked* to every request span whose chunks
+        # it batched, carrying occupancy and the graftcost-modeled
+        # cost so each launch is a measured-vs-modeled drift sample
+        # (the drift also lands as an encode.modeled_drift value).
+        n_tiles = sum(j.n_tiles for j in group)
+        attrs = {"occupancy": len(group), "tiles": n_tiles,
+                 "mode": group[0].mode}
+        modeled = None
+        # The modeled cost feeds both the span attrs and the /metrics
+        # drift distribution — compute it whenever either consumer is
+        # live (a sink without tracing still wants calibration data).
+        if (obs.installed() or self._sink is not None) \
+                and group[0].mode == "rows":
+            modeled = obs_cost.modeled_launch_seconds(n_tiles)
+            if modeled is not None:
+                attrs["modeled_s"] = round(modeled[0], 6)
+                attrs["modeled_from"] = modeled[1]
+        links = [j.ctx for j in group if j.ctx is not None]
+        failed = False
+        t0 = seam.monotonic()
         try:
-            if len(group) == 1:
-                result = launch(
-                    group[0].plan, group[0].tiles, mode=group[0].mode)
-                seam.write(group[0], "result")
-                group[0].result = result
-            else:
-                tiles = np.concatenate([j.tiles for j in group])
-                merged = launch(group[0].plan, tiles, mode="rows")
-                off = 0
-                for j in group:
-                    seam.write(j, "result")
-                    j.result = _SlicedPending(merged, off, j.n_tiles)
-                    off += j.n_tiles
+            with obs.span("device.launch", ctx=None, links=links,
+                          **attrs):
+                if len(group) == 1:
+                    result = launch(
+                        group[0].plan, group[0].tiles,
+                        mode=group[0].mode)
+                    seam.write(group[0], "result")
+                    group[0].result = result
+                else:
+                    tiles = np.concatenate([j.tiles for j in group])
+                    merged = launch(group[0].plan, tiles, mode="rows")
+                    off = 0
+                    for j in group:
+                        seam.write(j, "result")
+                        j.result = _SlicedPending(merged, off,
+                                                  j.n_tiles)
+                        off += j.n_tiles
         # The whole group shares the failed launch; the error is
         # delivered to every waiting request and re-raised there, so no
         # waiter hangs and nothing is swallowed.
         except Exception as exc:    # graftlint: disable=swallowed-exception
+            failed = True
             for j in group:
                 seam.write(j, "error")
                 j.error = exc
         finally:
             if self._sink is not None:
                 self._sink.count("encode.device_launches")
-                self._sink.count("encode.batched_tiles",
-                                 sum(j.n_tiles for j in group))
+                self._sink.count("encode.batched_tiles", n_tiles)
                 self._sink.observe("encode.batch_occupancy", len(group))
+                # Drift samples come from completed launches only: a
+                # launch that died 5 ms in would otherwise read as
+                # "10x faster than modeled" and poison the calibration
+                # distribution.
+                if modeled is not None and modeled[0] > 0 and not failed:
+                    self._sink.observe(
+                        "encode.modeled_drift",
+                        (seam.monotonic() - t0) / modeled[0])
             for j in group:
                 j.event.set()
 

@@ -27,6 +27,7 @@ import urllib.parse
 from .. import config as cfg
 from .. import constants as c
 from .. import features
+from .. import obs
 from .. import op
 from ..converters import Conversion, ConverterError
 from .bus import MessageBus, Reply
@@ -69,6 +70,12 @@ class ImageWorker:
         bus.consumer(IMAGE_WORKER, self.handle, instances=instances)
 
     async def handle(self, message: dict) -> Reply:
+        # Consumer tasks don't inherit the HTTP handler's contextvars:
+        # re-enter the request's trace context from the message.
+        with obs.request_context(message.get(c.REQUEST_ID)):
+            return await self._handle_convert(message)
+
+    async def _handle_convert(self, message: dict) -> Reply:
         image_id = message[c.IMAGE_ID]
         file_path = message[c.FILE_PATH]
         callback_url = message.get(c.CALLBACK_URL)

@@ -1,0 +1,101 @@
+"""graftcost-modeled cost for the merged launch span.
+
+The launch span carries a ``modeled_s`` attribute next to its measured
+duration so every device launch is a measured-vs-modeled data point —
+the drift signal ROADMAP item 1 needs to tell "the kernel got faster"
+from "the model was wrong". The model is the checked-in manifest's
+cost fingerprint (``.graftaudit-manifest.json``, written by
+``--write-manifest``) for the front-end program, rooflined through
+:mod:`..analysis.graftcost`'s machine models and scaled linearly from
+the nearest canonical batch bucket — deliberately cheap (one JSON read
+per process, no lowering at serve time) and deliberately approximate
+(the manifest models canonical variants, not every tile shape).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+
+_LOCK = threading.Lock()
+_CACHE: dict = {"loaded": False, "entries": None, "machine": None}
+
+
+def _pow2_at_least(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _load_entries():
+    """[(program_key, bucket_B, cost_dict)] for front-end row programs,
+    from the manifest at the repo/package root. None when unreadable."""
+    manifest = (Path(__file__).resolve().parents[2]
+                / ".graftaudit-manifest.json")
+    try:
+        data = json.loads(manifest.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError):
+        return None
+    entries = []
+    for key, rec in data.get("programs", {}).items():
+        if not key.startswith("frontend.rows/"):
+            continue
+        cost = rec.get("cost")
+        bucket = key.rsplit("/B", 1)[-1]
+        try:
+            b = int(bucket)
+        except ValueError:
+            continue
+        if cost:
+            entries.append((key, b, cost))
+    return entries or None
+
+
+def _machine():
+    """graftcost machine model matching the live backend (cpu vs
+    accelerator); None when neither graftcost nor jax is importable."""
+    try:
+        from ..analysis import graftcost
+    except ImportError:
+        return None
+    name = graftcost.DEFAULT_MACHINE
+    try:
+        import jax
+        if jax.default_backend() == "cpu":
+            name = "cpu"
+    except (ImportError, RuntimeError):
+        # No usable backend: keep the default machine — the model is
+        # order-of-magnitude either way.
+        name = graftcost.DEFAULT_MACHINE
+    return graftcost.MACHINES[name]
+
+
+def modeled_launch_seconds(n_tiles: int) -> tuple | None:
+    """(modeled seconds, source label) for a merged rows-mode front-end
+    launch of ``n_tiles`` tiles, or None when no model is available.
+    Picks the manifest entry with the nearest canonical bucket and
+    scales the roofline time by padded_tiles / bucket."""
+    with _LOCK:
+        if not _CACHE["loaded"]:
+            _CACHE["entries"] = _load_entries()
+            _CACHE["machine"] = _machine()
+            _CACHE["loaded"] = True
+        entries = _CACHE["entries"]
+        machine = _CACHE["machine"]
+    if not entries or machine is None or n_tiles <= 0:
+        return None
+    padded = _pow2_at_least(n_tiles)
+    key, bucket, cost = min(
+        entries, key=lambda e: (abs(e[1] - padded), e[0]))
+    t = (max(cost.get("flops", 0) / machine.peak_flops,
+             cost.get("hbm_bytes", 0) / machine.hbm_bytes_per_s)
+         + cost.get("scan_depth", 0) * machine.seq_step_s)
+    scaled = t * (padded / bucket)
+    return scaled, f"{key}@{machine.name}"
+
+
+def reset_cache() -> None:
+    """Test seam: drop the memoized manifest/machine."""
+    with _LOCK:
+        _CACHE.update(loaded=False, entries=None, machine=None)

@@ -22,7 +22,9 @@ import json
 import logging
 import os
 import re
+import time
 import urllib.parse
+import uuid
 
 from aiohttp import web
 
@@ -30,6 +32,7 @@ from .. import config as cfg
 from .. import constants as c
 from .. import job_factory
 from .. import models as m
+from .. import obs
 from ..codec.decode import DecodeError, InvalidParam
 from ..converters import TpuReader, available_converters, derivative_path
 from ..engine import Engine, start_job, update_item_status
@@ -121,6 +124,30 @@ class Api:
         # same /metrics registry.
         from ..engine import retry as engine_retry
         engine_retry.set_metrics_sink(self.metrics)
+        # graftscope (bucketeer_tpu/obs): the process recorder —
+        # request-scoped span trees, the always-on flight recorder
+        # behind GET /debug/flight, Chrome-trace export behind
+        # GET /debug/trace/{id}, request-id log stamping. Gated by
+        # BUCKETEER_TRACE (default on); its own counters (flight
+        # dumps/suppressions) land in this registry too.
+        recorder = obs.maybe_install()
+        if recorder is not None:
+            recorder.set_metrics_sink(self.metrics)
+        # Per-endpoint latency SLOs: the trace middleware reports every
+        # request here; breaches bump slo.breach.* counters and freeze
+        # the flight recorder with the request id attached.
+        self.slo = obs.SloWatchdog.parse(
+            engine.config.get_str(cfg.SLO)
+            or os.environ.get("BUCKETEER_SLO"),
+            sink=self.metrics,
+            flight=recorder.flight if recorder is not None else None)
+        if self.slo.active:
+            self.metrics.add_reporter("slo", self.slo.report)
+            # Keys are handler names (get_image, load_image, ...) —
+            # log the parsed spec so a typo'd/operationId-style key
+            # that will never match is visible at boot, not after an
+            # incident with no breach ever recorded.
+            LOG.info("SLO watchdog active: %s", self.slo.report())
         # Live breaker state (open/half_open/closed + consecutive
         # failures) rendered as a /metrics section beside the
         # transition counters.
@@ -179,6 +206,12 @@ class Api:
         message = {c.IMAGE_ID: image_id, c.FILE_PATH: file_path}
         if callback_url:
             message[c.CALLBACK_URL] = callback_url
+        # Trace context rides the message: the worker's consumer task
+        # re-enters it, so the convert/upload spans and log lines
+        # carry this request's id.
+        request_id = obs.current_request_id()
+        if request_id:
+            message[c.REQUEST_ID] = request_id
         with self.metrics.time("single_image"):
             reply = await self.engine.bus.request_with_retry(
                 IMAGE_WORKER, message)
@@ -635,7 +668,51 @@ class Api:
 
     # --- metrics (new: SURVEY.md §5 says the reference has none) ---
     async def get_metrics(self, request: web.Request) -> web.Response:
+        fmt = request.query.get("format", "json")
+        if fmt == "prometheus":
+            return web.Response(
+                text=self.metrics.prometheus(),
+                content_type="text/plain", charset="utf-8")
+        if fmt != "json":
+            return _error_page(400, f"unknown format: {fmt}")
         return web.json_response(self.metrics.report())
+
+    # --- graftscope debug surface (new: bucketeer_tpu/obs) ---
+    async def get_flight(self, request: web.Request) -> web.Response:
+        """The always-on flight recorder: recent spans across all
+        threads plus stored dumps (auto-frozen on 5xx / SLO breach).
+        ``?dump=<seq>`` fetches one stored dump in full; ``?freeze=1``
+        forces a dump right now (operator poke)."""
+        rec = obs.get_recorder()
+        if rec is None:
+            return web.json_response({"enabled": False})
+        if "dump" in request.query:
+            try:
+                seq = int(request.query["dump"])
+            except ValueError:
+                return _error_page(400, "dump must be an integer seq")
+            entry = rec.flight.get(seq)
+            if entry is None:
+                return _error_page(404, f"no flight dump with seq {seq}")
+            return web.json_response(entry)
+        if cfg.truthy(request.query.get("freeze")):
+            rec.flight.dump("operator-freeze", force=True)
+        return web.json_response(rec.flight.report())
+
+    async def get_trace(self, request: web.Request) -> web.Response:
+        """Per-request Chrome-trace/Perfetto JSON: every span of one
+        request id, plus linked merged-launch spans. Loads directly in
+        chrome://tracing / ui.perfetto.dev."""
+        rec = obs.get_recorder()
+        if rec is None:
+            return _error_page(503, "tracing disabled (BUCKETEER_TRACE)")
+        request_id = urllib.parse.unquote(
+            request.match_info["request_id"])
+        doc = obs.export.chrome_trace(rec, request_id)
+        if not doc["traceEvents"]:
+            return _error_page(
+                404, f"no buffered spans for request {request_id}")
+        return web.json_response(doc)
 
 
 def _coefficients_response(cs) -> web.Response:
@@ -694,6 +771,47 @@ def _image_response(img, fmt: str, bitdepth: int = 8) -> web.Response:
 
 
 @web.middleware
+async def trace_middleware(request: web.Request, handler):
+    """graftscope's HTTP root: every request gets a trace context
+    (inbound ``X-Request-Id`` honored, else generated), a root span
+    named after the handler, an ``http.<endpoint>`` latency sample
+    (the per-endpoint p50/p95/p99 behind /metrics), an SLO check, and
+    — for 5xx outcomes — an automatic flight-recorder dump. Outermost
+    middleware, so the error middleware's 500 mapping is visible
+    here as a status, not an exception."""
+    api = request.app.get("api")
+    request_id = request.headers.get("X-Request-Id") or uuid.uuid4().hex
+    endpoint = getattr(handler, "__name__", "handler")
+    t0 = time.perf_counter()
+    status = 500
+    with obs.request_context(request_id):
+        with obs.span(f"http.{endpoint}", method=request.method,
+                      path=request.path):
+            try:
+                response = await handler(request)
+                status = response.status
+                response.headers.setdefault("X-Request-Id", request_id)
+                return response
+            except web.HTTPException as exc:
+                # Raise-style responses (redirects, the 404->405
+                # rewrite) are outcomes, not errors.
+                status = exc.status
+                exc.headers.setdefault("X-Request-Id", request_id)
+                raise
+            finally:
+                if api is not None:
+                    dt = time.perf_counter() - t0
+                    api.metrics.record(f"http.{endpoint}", dt)
+                    breached = api.slo.observe(endpoint, dt,
+                                               request_id=request_id)
+                    if status >= 500 and not breached:
+                        rec = obs.get_recorder()
+                        if rec is not None:
+                            rec.flight.dump(f"error:{endpoint}",
+                                            request_id=request_id)
+
+
+@web.middleware
 async def error_middleware(request: web.Request, handler):
     try:
         return await handler(request)
@@ -716,7 +834,8 @@ def build_app(engine: Engine,
     """Assemble the aiohttp application (reference:
     MainVerticle.java:110-163)."""
     api = Api(engine)
-    app = web.Application(middlewares=[error_middleware],
+    app = web.Application(middlewares=[trace_middleware,
+                                       error_middleware],
                           client_max_size=512 * 1024 * 1024)
     app["api"] = api
     app["engine"] = engine
@@ -742,6 +861,8 @@ def build_app(engine: Engine,
     app.router.add_get("/batch/jobs/{job_name}", api.get_job_statuses)
     app.router.add_delete("/batch/jobs/{job_name}", api.delete_job)
     app.router.add_get("/metrics", api.get_metrics)
+    app.router.add_get("/debug/flight", api.get_flight)
+    app.router.add_get("/debug/trace/{request_id}", api.get_trace)
 
     # Static web UI (reference: src/main/webroot; MainVerticle.java:143-158)
     async def upload_redirect(request):

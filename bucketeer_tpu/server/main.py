@@ -25,9 +25,15 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
 
+    # Request-id stamping first (graftscope log correlation): every
+    # record then carries %(request_id)s — "-" outside a request —
+    # independent of whether tracing itself is enabled.
+    from ..obs import logctx
+    logctx.install()
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+        format="%(asctime)s %(levelname)s %(name)s "
+               "[%(request_id)s]: %(message)s")
 
     config = cfg.Config.load(args.config)
     port = args.port or config.get_int(cfg.HTTP_PORT)

@@ -7,14 +7,95 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import math
+import re
 import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 
+from .. import obs
 from ..analysis.graftrace import seam
 
 LOG = logging.getLogger(__name__)
+
+
+class LatencyHist:
+    """Fixed log2-bucketed histogram with quarter-octave resolution.
+
+    Buckets are geometric: bucket *i* covers
+    ``[2^((LO+i)/SUB), 2^((LO+i+1)/SUB))`` seconds with ``SUB=4``
+    sub-buckets per octave, spanning ~1 µs to 256 s, plus an underflow
+    and an overflow bucket. Fixed bounds mean zero allocation after
+    construction, O(1) observe, lossless merging across processes, and
+    a worst-case quantile error of one bucket width (2^(1/4) ≈ 19%) —
+    the server-side p50/p95/p99 the mean/min/max ``ValueStats`` could
+    never answer. The same shape backs the Prometheus
+    ``_bucket``/``_sum``/``_count`` exposition."""
+
+    SUB = 4                       # sub-buckets per octave
+    LO_EXP = -20                  # 2^-20 s ≈ 0.95 µs
+    HI_EXP = 8                    # 2^8 s = 256 s
+    N = (HI_EXP - LO_EXP) * SUB   # finite buckets
+
+    __slots__ = ("counts", "total", "sum")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (self.N + 2)   # [under] + finite + [over]
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.total += 1
+        self.sum += v
+        if v < 2.0 ** self.LO_EXP:
+            self.counts[0] += 1
+            return
+        i = int(math.floor(math.log2(v) * self.SUB)) \
+            - self.LO_EXP * self.SUB
+        if i >= self.N:
+            self.counts[self.N + 1] += 1
+        else:
+            self.counts[i + 1] += 1
+
+    @classmethod
+    def upper_bound(cls, i: int) -> float:
+        """Inclusive upper bound of counts[i] (Prometheus ``le``)."""
+        if i >= cls.N + 1:
+            return math.inf
+        return 2.0 ** ((cls.LO_EXP * cls.SUB + i) / cls.SUB)
+
+    def _bucket_value(self, i: int) -> float:
+        """Representative value of bucket i: geometric midpoint for
+        finite buckets, the adjacent edge for under/overflow."""
+        if i == 0:
+            return 2.0 ** self.LO_EXP
+        if i >= self.N + 1:
+            return 2.0 ** self.HI_EXP
+        lo = (self.LO_EXP * self.SUB + i - 1) / self.SUB
+        return 2.0 ** (lo + 0.5 / self.SUB)
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-quantile (0..1) from the buckets."""
+        if self.total == 0:
+            return 0.0
+        target = q * self.total
+        cum = 0
+        last = 0
+        for i, n in enumerate(self.counts):
+            if n == 0:
+                continue
+            cum += n
+            last = i
+            if cum + 1e-9 >= target:
+                return self._bucket_value(i)
+        return self._bucket_value(last)
+
+    def percentiles_ms(self) -> dict:
+        return {f"p{int(q * 100)}_ms":
+                round(self.percentile(q) * 1e3, 3)
+                for q in (0.5, 0.95, 0.99)}
 
 
 @dataclass
@@ -24,6 +105,7 @@ class StageStats:
     max_s: float = 0.0
     pixels: int = 0
     items: int = 0        # stage-specific unit (e.g. CX/D symbols)
+    hist: LatencyHist = field(default_factory=LatencyHist)
 
     def record(self, seconds: float, pixels: int = 0,
                items: int = 0) -> None:
@@ -32,6 +114,7 @@ class StageStats:
         self.max_s = max(self.max_s, seconds)
         self.pixels += pixels
         self.items += items
+        self.hist.observe(seconds)
 
 
 @dataclass
@@ -68,12 +151,14 @@ class OverlapStats:
 @dataclass
 class ValueStats:
     """Distribution of an observed value (no timing attached): batch
-    occupancy, queue lengths, ... — anything where mean/min/max of the
-    samples is the product metric."""
+    occupancy, queue lengths, ... Mean/min/max are kept for cheap
+    reading, but the product metric is the log2-bucket histogram —
+    p50/p95/p99 server-side, where the old aggregates hid the tail."""
     count: int = 0
     total: float = 0.0
     vmin: float = 0.0
     vmax: float = 0.0
+    hist: LatencyHist = field(default_factory=LatencyHist)
 
     def observe(self, value: float) -> None:
         if self.count == 0:
@@ -83,6 +168,7 @@ class ValueStats:
             self.vmax = max(self.vmax, value)
         self.count += 1
         self.total += value
+        self.hist.observe(value)
 
 
 @dataclass
@@ -112,11 +198,15 @@ class Metrics:
 
     @contextlib.contextmanager
     def time(self, stage: str, pixels: int = 0):
+        # Every timed stage is also a graftscope span (no-op without a
+        # recorder): the existing stage instrumentation across the
+        # codec/engine IS the span tree's interior, one seam for both.
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.record(stage, time.perf_counter() - t0, pixels)
+        with obs.span(stage):
+            try:
+                yield
+            finally:
+                self.record(stage, time.perf_counter() - t0, pixels)
 
     def record(self, stage: str, seconds: float, pixels: int = 0,
                items: int = 0) -> None:
@@ -188,6 +278,8 @@ class Metrics:
                 entry["items"] = st.items
                 if st.total_s > 0:
                     entry["items_per_s"] = round(st.items / st.total_s, 1)
+            if st.count:
+                entry.update(st.hist.percentiles_ms())
             out["stages"][name] = entry
         if self.overlaps:
             out["overlap"] = {}
@@ -203,16 +295,119 @@ class Metrics:
         if self.values:
             out["values"] = {}
             for name, vs in sorted(self.values.items()):
-                out["values"][name] = {
+                entry = {
                     "count": vs.count,
                     "mean": round(vs.total / vs.count, 4) if vs.count
                     else 0,
                     "min": round(vs.vmin, 4),
                     "max": round(vs.vmax, 4),
                 }
+                if vs.count:
+                    entry.update({
+                        f"p{int(q * 100)}":
+                        round(vs.hist.percentile(q), 4)
+                        for q in (0.5, 0.95, 0.99)})
+                out["values"][name] = entry
         if self.counters:
             out["counters"] = dict(sorted(self.counters.items()))
         return out
+
+    # -- Prometheus text exposition ------------------------------------
+
+    def prometheus(self) -> str:
+        """Render the registry in Prometheus text exposition format
+        (``GET /metrics?format=prometheus``): counters as one labelled
+        counter family, stages and values as labelled histogram
+        families with ``_bucket``/``_sum``/``_count`` series (sparse —
+        only buckets whose cumulative count changed, plus ``+Inf``),
+        overlap segments as gauges. tests/test_obs.py round-trips the
+        output through a minimal line-format checker."""
+        with self._lock:
+            seam.read(self, "stages")
+            seam.read(self, "counters")
+            seam.read(self, "values")
+            seam.read(self, "overlaps")
+            uptime = time.time() - self.started_at
+            counters = dict(self.counters)
+            stages = {name: (list(st.hist.counts), st.hist.sum,
+                             st.count)
+                      for name, st in self.stages.items()}
+            values = {name: (list(vs.hist.counts), vs.hist.sum,
+                             vs.count)
+                      for name, vs in self.values.items()}
+            overlaps = {name: (ov.count, ov.device_s, ov.host_s,
+                               ov.wall_s, ov.saved_s)
+                        for name, ov in self.overlaps.items()}
+        lines = [
+            "# HELP bucketeer_uptime_seconds Process uptime.",
+            "# TYPE bucketeer_uptime_seconds gauge",
+            f"bucketeer_uptime_seconds {uptime:.3f}",
+        ]
+        if counters:
+            lines += [
+                "# HELP bucketeer_counter_total Event counters.",
+                "# TYPE bucketeer_counter_total counter",
+            ]
+            for name, n in sorted(counters.items()):
+                lines.append(
+                    f'bucketeer_counter_total{{name="{_label(name)}"}}'
+                    f" {n}")
+        for family, label, series, help_text in (
+                ("bucketeer_stage_seconds", "stage", stages,
+                 "Per-stage latency (log2-bucketed)."),
+                ("bucketeer_value", "name", values,
+                 "Observed value distributions (log2-bucketed).")):
+            if not series:
+                continue
+            lines += [
+                f"# HELP {family} {help_text}",
+                f"# TYPE {family} histogram",
+            ]
+            for name, (counts, hsum, count) in sorted(series.items()):
+                sel = f'{label}="{_label(name)}"'
+                cum = 0
+                for i, n in enumerate(counts):
+                    if n == 0:
+                        continue
+                    cum += n
+                    le = _fmt_float(LatencyHist.upper_bound(i))
+                    lines.append(
+                        f'{family}_bucket{{{sel},le="{le}"}} {cum}')
+                lines.append(
+                    f'{family}_bucket{{{sel},le="+Inf"}} {cum}')
+                lines.append(
+                    f'{family}_sum{{{sel}}} {_fmt_float(hsum)}')
+                lines.append(f'{family}_count{{{sel}}} {count}')
+        if overlaps:
+            lines += [
+                "# HELP bucketeer_overlap_seconds Pipelined "
+                "device/host segment seconds.",
+                "# TYPE bucketeer_overlap_seconds gauge",
+            ]
+            for name, (count, dev, host, wall, saved) in sorted(
+                    overlaps.items()):
+                base = f'stage="{_label(name)}"'
+                for seg, val in (("device", dev), ("host", host),
+                                 ("wall", wall), ("saved", saved)):
+                    lines.append(
+                        f'bucketeer_overlap_seconds{{{base},'
+                        f'segment="{seg}"}} {_fmt_float(val)}')
+        return "\n".join(lines) + "\n"
+
+
+_LABEL_BAD = re.compile(r'[\\"\n]')
+
+
+def _label(value: str) -> str:
+    """Escape a Prometheus label value (names here are dotted metric
+    names, but the renderer must never emit a broken line)."""
+    return _LABEL_BAD.sub("_", str(value))
+
+
+def _fmt_float(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    return f"{v:.9g}"
 
 
 # Process-wide registry: the encoder reports into one well-known object

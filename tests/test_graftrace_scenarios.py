@@ -15,7 +15,8 @@ def test_default_suite_covers_the_required_scenarios():
     names = set(scenarios.default_names())
     assert {"merged_batch_encode", "read_vs_batch_priority",
             "queuefull_deadline", "cache_eviction",
-            "shutdown_drain", "worker_crash_requeue"} <= names
+            "shutdown_drain", "worker_crash_requeue",
+            "span_ring_concurrency"} <= names
     assert "synthetic_race" not in names
     assert "synthetic_inversion" not in names
 

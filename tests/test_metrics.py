@@ -84,8 +84,12 @@ def test_observe_value_distribution():
     for v in (4, 1, 3):
         m.observe("encode.batch_occupancy", v)
     entry = m.report()["values"]["encode.batch_occupancy"]
-    assert entry == {"count": 3, "mean": pytest.approx(8 / 3, abs=1e-3),
-                     "min": 1.0, "max": 4.0}
+    assert entry["count"] == 3
+    assert entry["mean"] == pytest.approx(8 / 3, abs=1e-3)
+    assert (entry["min"], entry["max"]) == (1.0, 4.0)
+    # The histogram percentiles ride along (quarter-octave buckets).
+    assert entry["p50"] == pytest.approx(3.0, rel=0.25)
+    assert entry["p99"] == pytest.approx(4.0, rel=0.25)
 
 
 def test_value_stats_single_sample_min_max():
@@ -129,3 +133,14 @@ def test_concurrent_hammer_never_loses_updates():
     ov = m.overlaps["hammer.overlap"]
     assert ov.count == total
     assert ov.device_s == pytest.approx(0.001 * total, rel=1e-6)
+    # The log2-bucket histograms ride the same lock: racing observes
+    # must be lossless too (every sample lands in exactly one bucket).
+    assert st.hist.total == total
+    assert sum(st.hist.counts) == total
+    vh = m.values["hammer.value"].hist
+    assert vh.total == total
+    assert sum(vh.counts) == total
+    # All stage samples were 1 ms: the histogram's p50 sits in the
+    # same quarter-octave bucket.
+    assert rep["stages"]["hammer.stage"]["p50_ms"] == \
+        pytest.approx(1.0, rel=0.25)
