@@ -168,36 +168,32 @@ def registry() -> list:
         + ([sds((1, 64, 64, 1), jnp.int32)],)))
 
     def cxd_args(n):
+        # Block batch + per-block meta + the runtime fixed-point shift
+        # (dynamic on purpose: lossless and lossy share one compile).
         return ([sds((n, 64, 64), jnp.int32)]
-                + [sds((n,), jnp.int32)] * 5)
+                + [sds((n,), jnp.int32)] * 5 + [sds((), jnp.int32)])
 
     entries.append(AuditProgram(
-        "cxd.scan/P2/N1",
-        lambda: cxd.cxd_program(2, 0, pallas=False) + (cxd_args(1),)))
+        "cxd.scan/L2/N1",
+        lambda: cxd.cxd_program(2, pallas=False) + (cxd_args(1),)))
     entries.append(AuditProgram(
-        "cxd.scan.pallas/P2/N1",
-        lambda: cxd.cxd_program(2, 0, pallas=True, interpret=True)
+        "cxd.scan.pallas/L2/N1",
+        lambda: cxd.cxd_program(2, pallas=True, interpret=True)
         + (cxd_args(1),)))
-    # Device-MQ chain (BUCKETEER_DEVICE_MQ): the raw-symbol CX/D
-    # variant feeding the MQ-coder scan, and the MQ scan itself in both
-    # implementations (the Pallas kernel in interpret mode).
+    # Fused device Tier-1 (BUCKETEER_DEVICE_MQ): CX/D context modeling
+    # chained straight into the MQ coder inside one program, so the
+    # (N, max_syms) symbol buffer never exists in HBM (the
+    # perf-hbm-roundtrip the old two-program chain carried). The MQ
+    # half's trip count is the realized symbol cursor — a dynamic
+    # while the static cost model reports as unknown_trips rather
+    # than a readable depth.
     entries.append(AuditProgram(
-        "cxd.scan.raw/P2/N1",
-        lambda: cxd.cxd_program(2, 0, pallas=False, raw=True)
+        "cxdmq.fused/L2/N1",
+        lambda: cxd.fused_program(2, pallas=False) + (cxd_args(1),)))
+    entries.append(AuditProgram(
+        "cxdmq.fused.pallas/L2/N1",
+        lambda: cxd.fused_program(2, pallas=True, interpret=True)
         + (cxd_args(1),)))
-
-    def mq_args(n):
-        return [sds((n, cxd.max_syms(2)), jnp.uint8),
-                sds((n, 2, 3), jnp.int32), sds((n,), jnp.int32),
-                sds((n,), jnp.int32)]
-
-    entries.append(AuditProgram(
-        "mq.scan/P2/S1024/N1",
-        lambda: cxd.mq_program(2, 1024, pallas=False) + (mq_args(1),)))
-    entries.append(AuditProgram(
-        "mq.scan.pallas/P2/S1024/N1",
-        lambda: cxd.mq_program(2, 1024, pallas=True, interpret=True)
-        + (mq_args(1),)))
 
     iplan_g = ddevice.make_inverse_plan(64, 64, 1, 2, True, 8, False,
                                         lambda lvl, name: 1.0)
@@ -239,9 +235,9 @@ def registry() -> list:
     # changes the aval) and the coefficient dequantizer (Tier-1
     # half-magnitudes -> device-resident subband coefficients; input
     # donated on the reversible int32->int32 path, verified dropped on
-    # the float32 path). The CX/D + MQ programs the tensor codec
-    # chains after the packer are the cxd.scan.raw / mq.scan entries
-    # above — one program, two workloads.
+    # the float32 path). The Tier-1 program the tensor codec chains
+    # after the packer is the cxdmq.fused entry above — one program,
+    # two workloads.
     from ..tensor import codec as tcodec
     from ..tensor import coeffs as tcoeffs
 

@@ -769,20 +769,30 @@ def render_cost_line(c: CostFacts, machine: MachineModel) -> str:
 _PREDICTION_CACHE: dict = {}
 
 
+# Nominal symbols per modeled block for the calibration metric — the
+# historical audit-bucket MQ step count. The fused program's MQ half
+# runs a realized-cursor while the static extractor cannot read, so
+# its sequential cost is added explicitly below from this count.
+PREDICTION_SYMS = 1024
+
+
 def tier1_prediction() -> dict:
     """Modeled device-Tier-1 symbol throughput per machine model, from
-    the registry's CX/D-raw + MQ-scan programs at their audit buckets
-    (one block, P=2, 1024 MQ steps). ``bench.py`` emits this beside the
-    measured ``tier1_split`` symbols/s and records the prediction
+    the registry's fused CX/D+MQ program at its audit bucket (one
+    block, L=2). The fused MQ half's trip count is dynamic (realized
+    cursor), so the roofline covers the static CX/D scan and the MQ
+    sequential term is added as ``PREDICTION_SYMS / MQ_UNROLL`` trips
+    of the machine's seq-step overhead. ``bench.py`` emits this beside
+    the measured ``tier1_split`` symbols/s and records the prediction
     error — the calibration loop that keeps the machine numbers
-    honest. Lowers two programs on first use (cached per process)."""
+    honest. Lowers one program on first use (cached per process)."""
     if _PREDICTION_CACHE:
         return dict(_PREDICTION_CACHE)
     from . import deviceaudit
+    from ..codec.cxd import MQ_UNROLL
 
-    wanted = {"cxd.scan.raw", "mq.scan"}
     entries = [e for e in deviceaudit.registry()
-               if e.name.split("/")[0] in wanted]
+               if e.name.split("/")[0] == "cxdmq.fused"]
     costs = {}
     for facts in deviceaudit.run_programs(entries):
         if facts.skipped:
@@ -790,16 +800,25 @@ def tier1_prediction() -> dict:
         # run_programs already attached the modeled cost.
         costs[facts.name.split("/")[0]] = (
             facts.cost or cost_program(facts.text, facts.name))
-    if set(costs) != wanted:
+    fused = costs.get("cxdmq.fused")
+    if fused is None:
         return {}
-    # One modeled block carries the MQ program's bucketed step count
-    # of symbols — read from the model, not hard-coded, so a registry
-    # bucket change cannot silently skew the calibration metric.
-    syms = float(costs["mq.scan"].max_trip or 1024)
+    # Consistency guard (the old code read the count from the modeled
+    # MQ bucket; the fused program's MQ length is dynamic, so the
+    # workload assumption lives here): the assumed symbol count must
+    # fit the registered audit bucket's symbol capacity, read from the
+    # registry name — a bucket change that invalidates the assumption
+    # trips this instead of silently skewing the calibration metric.
+    from ..codec.cxd import max_syms
+    m = re.search(r"/L(\d+)/", fused.name)
+    if m is None or PREDICTION_SYMS > max_syms(int(m.group(1))):
+        return {}
+    syms = float(PREDICTION_SYMS)
+    mq_trips = -(-PREDICTION_SYMS // MQ_UNROLL)
     out = {}
     for mname, machine in MACHINES.items():
-        t = (costs["cxd.scan.raw"].roofline(machine)["time_s"]
-             + costs["mq.scan"].roofline(machine)["time_s"])
+        t = (fused.roofline(machine)["time_s"]
+             + mq_trips * machine.seq_step_s)
         out[mname] = {"symbols_per_s": round(syms / t, 1),
                       "modeled_block_s": round(t, 6)}
     _PREDICTION_CACHE.update(out)

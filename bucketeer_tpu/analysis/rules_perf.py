@@ -14,14 +14,17 @@ entry that itself fails ``--strict`` until pruned.
 | ``perf-scan-per-element`` | a ``stablehlo.while`` trip count >= one
   step per stripe column of a single pass (1024 for 64x64 blocks) —
   the scan serializes per coefficient/symbol rather than per
-  vectorizable stripe column. The CX/D and MQ scans are today's
-  offenders; stripe-column vectorization (ROADMAP item 1) must cut
-  this number, and the manifest drift gate pins the claim. |
+  vectorizable stripe column. The pre-stripe-parallel CX/D and MQ
+  scans were the offenders; the restructured scans (COLS_PER_TRIP
+  columns per trip, MQ_UNROLL symbols per trip, Mb-clamped plane
+  loops) sit well under the threshold and the manifest drift gate
+  pins that. |
 | ``perf-hbm-roundtrip`` | a declared program chain ships a large
   intermediate through HBM — produced by one program, reconsumed by
-  the next (the (N, max_syms) symbol buffer between the raw CX/D scan
-  and the MQ coder). Fusing the chain (one kernel, VMEM-resident
-  buffer) removes the finding. |
+  the next. The one historical chain (the (N, max_syms) symbol buffer
+  between the raw CX/D scan and the MQ coder) was fused away
+  (``cxd.fused_program``); CHAINS is empty until a new hand-off
+  appears. |
 | ``perf-low-intensity-kernel`` | a Pallas program models below the
   intensity threshold (flop/byte) — memory-bound by construction, so
   kernel-side compute tuning is wasted until its traffic shrinks. |
@@ -53,13 +56,12 @@ LOW_INTENSITY_THRESHOLD = 1.0
 # Declared program chains (source family -> dest family, what travels):
 # the audit models each program alone; these name the HBM hand-offs
 # between them. Keyed by registry-name family (text before the first
-# "/"), so bucket suffixes don't matter.
-CHAINS = (
-    ("cxd.scan.raw", "mq.scan",
-     "the (N, max_syms) uint8 symbol buffer"),
-    ("cxd.scan.raw", "mq.scan.pallas",
-     "the (N, max_syms) uint8 symbol buffer"),
-)
+# "/"), so bucket suffixes don't matter. Empty today: the one declared
+# chain — the (N, max_syms) uint8 symbol buffer between the raw CX/D
+# scan and the MQ coder — was fused away (cxd.fused_program keeps the
+# buffer a program-internal value; registry entries cxdmq.fused*), so
+# its perf-hbm-roundtrip findings are resolved, not baselined.
+CHAINS = ()
 
 
 def _loc(name: str) -> str:

@@ -80,13 +80,22 @@ def _device_cxd(params: EncodeParams) -> bool:
 
 
 def _device_mq(params: EncodeParams) -> bool:
-    """Whether this encode runs Tier-1 entirely on device (CX/D scan +
-    MQ arithmetic coder, codec/cxd.py run_device_mq): the explicit
-    EncodeParams.device_mq wins, else BUCKETEER_DEVICE_MQ. Implies the
-    CX/D split (the MQ scan consumes the device symbol buffer)."""
+    """Whether this encode runs Tier-1 entirely on device (the fused
+    CX/D + MQ program, codec/cxd.py run_device_mq): the explicit
+    EncodeParams.device_mq wins, else BUCKETEER_DEVICE_MQ. The env
+    default is "auto": device MQ on the TPU backend only — on the CPU
+    backend the jnp scans emulate the device and the measured
+    ``tier1_split`` (BENCH_r08) shows the native host replay beating
+    the emulated device by orders of magnitude, and other accelerator
+    backends stay opt-in until their own split is measured; flip with
+    BUCKETEER_DEVICE_MQ=1/0 (docs/pipeline.md flag table)."""
     if params.device_mq is not None:
         return bool(params.device_mq)
-    return cfg_truthy(os.environ.get("BUCKETEER_DEVICE_MQ"))
+    env = os.environ.get("BUCKETEER_DEVICE_MQ", "auto")
+    if env == "auto":
+        import jax
+        return jax.default_backend() == "tpu"
+    return cfg_truthy(env)
 
 
 class _ImmediateResult:

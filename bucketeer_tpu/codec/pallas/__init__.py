@@ -1,15 +1,19 @@
 """Hand-written Pallas TPU kernels.
 
-Two kernels carry Tier-1 on device:
+Three kernels carry Tier-1 on device:
 
-- :mod:`.cxd_scan` — the EBCOT CX/D stripe scan (context modeling),
-  keeping a code-block's significance state and symbol buffer resident
-  in VMEM for the whole plane walk instead of letting XLA spill the
-  batched scan state through HBM (``BUCKETEER_DEVICE_CXD``).
-- :mod:`.mq_scan` — the MQ arithmetic coder, a per-symbol byte-emitting
-  scan chained after the CX/D scan so finished per-pass byte segments
-  (not symbol streams, not work) are all that ever reaches the host
-  (``BUCKETEER_DEVICE_MQ``).
+- :mod:`.cxd_scan` — the stripe-parallel EBCOT CX/D scan (context
+  modeling), keeping a code-block's significance state and symbol
+  buffer resident in VMEM for the whole Mb-clamped plane walk instead
+  of letting XLA spill the batched scan state through HBM
+  (``BUCKETEER_DEVICE_CXD``).
+- :mod:`.fused_t1` — the production device-MQ path: the CX/D scan
+  chained straight into the MQ arithmetic coder inside one kernel, the
+  symbol buffer a kernel-local VMEM value that never touches HBM, so
+  finished per-pass byte segments (not symbol streams, not work) are
+  all that ever reaches the host (``BUCKETEER_DEVICE_MQ``).
+- :mod:`.mq_scan` — the standalone MQ coder kernel, the per-block
+  parity/oracle surface for the fused kernel's back half.
 
 Selection: codec/cxd.py picks the Pallas kernels on the TPU backend and
 the plain-jnp ``lax.scan`` formulations elsewhere (CPU dev mode,

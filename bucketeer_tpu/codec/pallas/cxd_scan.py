@@ -1,16 +1,22 @@
 """Pallas TPU kernel for the EBCOT CX/D stripe scan (codec/cxd.py).
 
-The first hand-written kernel in this package. One code-block per grid
-cell: the block's (64, 64) int32 coefficients land in VMEM, the kernel
-runs the same stripe-column step function the jnp path scans with
-(``cxd._make_step`` — shared verbatim, so the two implementations cannot
-drift), carrying the significance state, symbol buffer and pass
-counters through a ``lax.fori_loop`` over the P*3*1024 plane/pass/column
-steps, and writes the per-block symbol stream + pass tables back out.
+One code-block per grid cell: the block's (64, 64) int32 coefficients
+land in VMEM and the kernel runs the same stripe-parallel scan the jnp
+path vmaps (``cxd._cxd_single`` — shared verbatim, so the two
+implementations cannot drift): an outer loop over plane *offsets* from
+the block's MSB (the Mb clamp — the launch group's ``L`` bounds the
+depth, the first plane's sigprop/magref trips are peeled away) around
+three specialized pass loops, each trip covering ``cxd.COLS_PER_TRIP``
+adjacent stripe columns through one wide VMEM state slice. The only
+divergence from the jnp path is mechanical: symbol emissions replay the
+shared trip's cursor positions as per-slot dynamic stores
+(``batch_emit=False``) instead of one batched scatter, and the context
+tables arrive as kernel inputs (kernels cannot capture array
+constants).
 
 Why Pallas at all: the jnp formulation materializes the scan as an XLA
-while-loop over (N, ...) batched state with one dynamic-slice/scatter
-bundle per stripe column — fine on CPU, but on TPU the batched gathers
+while-loop over (N, ...) batched state with one gather/scatter bundle
+per stripe trip — fine on CPU, but on TPU the batched gathers
 round-trip through HBM layouts the compiler picks. Here the whole
 working set (state ~17 KB, symbol buffer ~100 KB, coefficients 16 KB)
 is pinned in VMEM for the kernel's lifetime and only the finished
@@ -20,11 +26,11 @@ Compiled-TPU status: the kernel is a product path, not a parity
 artifact. The grid's block axis is declared ``parallel``
 (:func:`_tpu_params`) so Mosaic may fan code-blocks out across
 TensorCores — every grid cell reads and writes disjoint slices — and
-the batch axis is pow-2 bucketed upstream (frontend/scheduler batch
-buckets flow through ``run_cxd``/``run_device_mq`` unchanged) so a
-long-running service compiles O(log max-batch) kernel variants, not one
-per chunk size. Selection is ``BUCKETEER_CXD_PALLAS`` (default: auto —
-TPU backend only) behind the Mosaic capability probe (support.py):
+the batch axis is pow-2 bucketed upstream (the Mb-clamped launch
+groups of ``run_cxd``/``run_device_mq``) so a long-running service
+compiles O(log max-batch x log max-planes) kernel variants, not one
+per chunk shape. Selection is ``BUCKETEER_CXD_PALLAS`` (default: auto
+— TPU backend only) behind the Mosaic capability probe (support.py):
 backends that cannot compile Pallas programs downgrade to the jnp scan
 with a logged reason + metrics counter instead of dying at first
 dispatch (the BENCH_r02/r05 axon failure mode). Semantics stay locked
@@ -32,10 +38,9 @@ to the jnp path by interpret-mode parity tests (tests/test_cxd.py) on
 every CI run, and the device audit (analysis/deviceaudit.py, CI
 ``audit`` job) lowers the interpret-mode program on CPU every PR — via
 ``cxd.cxd_program(..., pallas=True, interpret=True)`` — so structural
-drift in the kernel's emitted ops (and any host callback or f64
-creeping in) fails a PR even without TPU hardware in the loop; the
-measured-throughput side (symbols/s, bytes/s) is the bench's
-``tier1_split`` report.
+drift in the kernel's emitted ops fails a PR even without TPU hardware
+in the loop; the measured-throughput side (symbols/s, bytes/s) is the
+bench's ``tier1_split`` report.
 """
 from __future__ import annotations
 
@@ -43,7 +48,6 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
 
 try:                                    # CPU-only jaxlibs lack the TPU ext
@@ -77,30 +81,15 @@ def _tpu_params(interpret: bool) -> dict:
     return {}                           # pragma: no cover - version skew
 
 
-def _kernel(P: int, frac_bits: int, n_steps: int,
+def _kernel(L: int,
             coeff_ref, meta_ref, zc_ref, scc_ref, scx_ref,
             buf_ref, counts_ref, dh_ref, dl_ref, cur_ref):
     coeffs = coeff_ref[0]
     nbp, floor = meta_ref[0, 0], meta_ref[0, 1]
     cls, h, w = meta_ref[0, 2], meta_ref[0, 3], meta_ref[0, 4]
-    idx = (jnp.abs(coeffs) >> frac_bits).astype(jnp.int32)
-    idx = (idx >> floor) << floor       # packed-path floor truncation
-    neg = (coeffs < 0).astype(jnp.int32)
-    step = cxd._make_step(P, idx, neg, nbp, floor, cls, h, w,
-                          tables=(zc_ref[:], scc_ref[:], scx_ref[:]))
-
-    def body(t, carry):
-        # Decode the flat step index into (plane, pass, stripe, column)
-        # — same order as cxd.scan_xs, planes descending.
-        plane = P - 1 - t // (3 * cxd.COLS_PER_PLANE)
-        rem = t % (3 * cxd.COLS_PER_PLANE)
-        pt = rem // cxd.COLS_PER_PLANE
-        s = rem % cxd.COLS_PER_PLANE
-        xt = jnp.stack([plane, pt, (s // CBLK) * 4, s % CBLK])
-        return step(carry, xt)[0]
-
-    _, _, _, cur, buf, counts, dh, dl = lax.fori_loop(
-        0, n_steps, body, cxd.init_state(P))
+    buf, counts, dh, dl, cur = cxd._cxd_single(
+        L, meta_ref[0, 5], coeffs, nbp, floor, cls, h, w,
+        tables=(zc_ref[:], scc_ref[:], scx_ref[:]), batch_emit=False)
     buf_ref[0] = buf
     counts_ref[0] = counts
     dh_ref[0] = dh
@@ -108,45 +97,61 @@ def _kernel(P: int, frac_bits: int, n_steps: int,
     cur_ref[0, 0] = cur
 
 
-def cxd_pallas(P: int, frac_bits: int, blocks, nbps, floors, cls, hs, ws,
-               interpret: bool = False):
-    """Drop-in replacement for the vmapped jnp scan: (N, 64, 64) int32
-    blocks -> (buf (N, max_syms) uint8, counts (N, P, 3) int32,
-    dh/dl (N, P, 3) float32, cursors (N,) int32)."""
-    n = blocks.shape[0]
-    msym = cxd.max_syms(P)
-    n_steps = P * 3 * cxd.COLS_PER_PLANE
-    meta = jnp.stack([nbps, floors, cls, hs, ws], axis=1).astype(jnp.int32)
+def _table_specs():
     sc_c, sc_x = cxd._sc_tables()
     zc = jnp.asarray(cxd._zc_stack())
     vmem = dict(memory_space=pltpu.VMEM) if pltpu is not None else {}
+    specs = [
+        pl.BlockSpec(zc.shape, lambda b: (0, 0, 0, 0), **vmem),
+        pl.BlockSpec(sc_c.shape, lambda b: (0, 0), **vmem),
+        pl.BlockSpec(sc_x.shape, lambda b: (0, 0), **vmem),
+    ]
+    return (zc, jnp.asarray(sc_c), jnp.asarray(sc_x)), specs
+
+
+def _meta_stack(nbps, floors, cls, hs, ws, frac):
+    """Per-block scalar metadata incl. the runtime fixed-point shift
+    (broadcast — one value per launch) as one SMEM-resident (N, 6)
+    int32 input."""
+    return jnp.stack([nbps, floors, cls, hs, ws,
+                      jnp.broadcast_to(frac, nbps.shape)],
+                     axis=1).astype(jnp.int32)
+
+
+def cxd_pallas(L: int, frac, blocks, nbps, floors, cls, hs, ws,
+               interpret: bool = False):
+    """Drop-in replacement for the vmapped jnp scan: (N, 64, 64) int32
+    blocks -> (buf (N, max_syms) uint8, counts (N, L, 3) int32,
+    dh/dl (N, L, 3) float32, cursors (N,) int32). ``frac`` is the
+    runtime fixed-point shift (scalar)."""
+    n = blocks.shape[0]
+    msym = cxd.max_syms(L)
+    meta = _meta_stack(nbps, floors, cls, hs, ws, frac)
+    tables, table_specs = _table_specs()
+    vmem = dict(memory_space=pltpu.VMEM) if pltpu is not None else {}
     smem = dict(memory_space=pltpu.SMEM) if pltpu is not None else {}
     buf, counts, dh, dl, cur = pl.pallas_call(
-        partial(_kernel, P, frac_bits, n_steps),
+        partial(_kernel, L),
         grid=(n,),
         in_specs=[
             pl.BlockSpec((1, CBLK, CBLK), lambda b: (b, 0, 0), **vmem),
-            pl.BlockSpec((1, 5), lambda b: (b, 0), **smem),
-            pl.BlockSpec(zc.shape, lambda b: (0, 0, 0, 0), **vmem),
-            pl.BlockSpec(sc_c.shape, lambda b: (0, 0), **vmem),
-            pl.BlockSpec(sc_x.shape, lambda b: (0, 0), **vmem),
-        ],
+            pl.BlockSpec((1, 6), lambda b: (b, 0), **smem),
+        ] + table_specs,
         out_specs=(
             pl.BlockSpec((1, msym), lambda b: (b, 0), **vmem),
-            pl.BlockSpec((1, P, 3), lambda b: (b, 0, 0), **vmem),
-            pl.BlockSpec((1, P, 3), lambda b: (b, 0, 0), **vmem),
-            pl.BlockSpec((1, P, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, L, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, L, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, L, 3), lambda b: (b, 0, 0), **vmem),
             pl.BlockSpec((1, 1), lambda b: (b, 0), **smem),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((n, msym), jnp.uint8),
-            jax.ShapeDtypeStruct((n, P, 3), jnp.int32),
-            jax.ShapeDtypeStruct((n, P, 3), jnp.float32),
-            jax.ShapeDtypeStruct((n, P, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n, L, 3), jnp.int32),
+            jax.ShapeDtypeStruct((n, L, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n, L, 3), jnp.float32),
             jax.ShapeDtypeStruct((n, 1), jnp.int32),
         ),
         interpret=interpret,
         **_tpu_params(interpret),
-    )(blocks.astype(jnp.int32), meta, zc, jnp.asarray(sc_c),
-      jnp.asarray(sc_x))
+    )(blocks.astype(jnp.int32), meta, *tables)
     return buf, counts, dh, dl, cur[:, 0]

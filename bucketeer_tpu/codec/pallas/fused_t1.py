@@ -1,0 +1,120 @@
+"""Pallas TPU kernel for the fused device Tier-1 (codec/cxd.py
+``fused_program``): CX/D context modeling chained straight into the MQ
+arithmetic coder inside one kernel.
+
+One code-block per grid cell. The block's coefficients land in VMEM,
+the kernel runs the shared stripe-parallel CX/D scan
+(``cxd._cxd_single``, ``batch_emit=False``), and the resulting symbol
+buffer — the (N, max_syms) intermediate that used to round-trip HBM
+between the two-program chain (the ``perf-hbm-roundtrip`` finding) —
+stays a kernel-local VMEM value consumed directly by the MQ back half
+(``mq_scan._mq_block``'s chunk step). The MQ loop's trip count is the
+block's *realized* symbol cursor (a scalar while, not a capacity-sized
+fori): symbol capacity is a multiple of ``MQ_UNROLL``, so the last
+chunk slice stays in bounds. Only finished byte segments, truncation
+snapshots and distortion pairs leave the core.
+
+VMEM working set per block at the largest plane bucket (L=32): the
+symbol buffer (max_syms(32) ~ 196 KB), the byte buffer (~100 KB),
+coefficients and scan state (~33 KB), tables ~1 KB — comfortably
+resident; the common L=8/16 buckets use roughly a quarter/half of
+that.
+
+Semantics are locked to the jnp fused body by interpret-mode parity
+tests (tests/test_mq_device.py) and the device audit lowers the
+interpret-mode program on CPU per PR (``cxd.fused_program(...,
+pallas=True, interpret=True)``, registry ``cxdmq.fused.pallas``). On
+hardware the kernel sits behind the same ``BUCKETEER_CXD_PALLAS`` gate
+and Mosaic capability probe as the other Tier-1 kernels.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:                                    # CPU-only jaxlibs lack the TPU ext
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:                     # pragma: no cover
+    pltpu = None
+
+from .. import cxd
+from .cxd_scan import _table_specs, _tpu_params
+
+
+def _kernel(L: int, cap: int,
+            coeff_ref, meta_ref, zc_ref, scc_ref, scx_ref, qe_ref,
+            rows_ref, snaps_ref, dlen_ref, dh_ref, dl_ref, cur_ref,
+            curb_ref):
+    coeffs = coeff_ref[0]
+    nbp, floor = meta_ref[0, 0], meta_ref[0, 1]
+    cls, h, w = meta_ref[0, 2], meta_ref[0, 3], meta_ref[0, 4]
+    buf, counts, dh, dl, cur = cxd._cxd_single(
+        L, meta_ref[0, 5], coeffs, nbp, floor, cls, h, w,
+        tables=(zc_ref[:], scc_ref[:], scx_ref[:]), batch_emit=False)
+    ops = cxd._mq_ops(batched=False)
+    flag = (nbp > floor).astype(jnp.int32)
+    carry = cxd._mq_drive_while(ops, qe_ref[:], cap, buf, counts, cur,
+                                cur, cxd._mq_state(ops, (), L, cap))
+    bytebuf, snaps, dlen, curb = cxd._mq_flush(ops, carry, flag != 0,
+                                               cap)
+    rows_ref[0] = bytebuf
+    snaps_ref[0] = snaps
+    dh_ref[0] = dh
+    dl_ref[0] = dl
+    dlen_ref[0, 0] = dlen
+    cur_ref[0, 0] = cur
+    curb_ref[0, 0] = curb
+
+
+def fused_pallas(L: int, frac, blocks, nbps, floors, cls,
+                 hs, ws, interpret: bool = False):
+    """Drop-in replacement for the jnp fused body (``cxd._fused_body``):
+    (N, 64, 64) int32 blocks + per-block meta -> (byte rows
+    (N*cap/512, 512) uint8, snaps (N, L, 3) int32, dlen (N,) int32,
+    dh/dl (N, L, 3) float32, symbol cursors (N,) int32, byte cursors
+    (N,) int32). ``frac`` is the runtime fixed-point shift (scalar)."""
+    from .cxd_scan import _meta_stack
+
+    n = blocks.shape[0]
+    cap = cxd.mq_capacity(cxd.max_syms(L))
+    meta = _meta_stack(nbps, floors, cls, hs, ws, frac)
+    tables, table_specs = _table_specs()
+    qe = jnp.asarray(cxd._QE_ARR)
+    vmem = dict(memory_space=pltpu.VMEM) if pltpu is not None else {}
+    smem = dict(memory_space=pltpu.SMEM) if pltpu is not None else {}
+    rows, snaps, dlen, dh, dl, cur, curb = pl.pallas_call(
+        partial(_kernel, L, cap),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, cxd.CBLK, cxd.CBLK),
+                         lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, 6), lambda b: (b, 0), **smem),
+        ] + table_specs + [
+            pl.BlockSpec(qe.shape, lambda b: (0, 0), **vmem),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, cap), lambda b: (b, 0), **vmem),
+            pl.BlockSpec((1, L, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, 1), lambda b: (b, 0), **smem),
+            pl.BlockSpec((1, L, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, L, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, 1), lambda b: (b, 0), **smem),
+            pl.BlockSpec((1, 1), lambda b: (b, 0), **smem),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, cap), jnp.uint8),
+            jax.ShapeDtypeStruct((n, L, 3), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n, L, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n, L, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        ),
+        interpret=interpret,
+        **_tpu_params(interpret),
+    )(blocks.astype(jnp.int32), meta, *tables, qe)
+    return (rows.reshape(-1, cxd.MQ_ROW_BYTES), snaps, dlen[:, 0],
+            dh, dl, cur[:, 0], curb[:, 0])

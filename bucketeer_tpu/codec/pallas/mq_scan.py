@@ -1,28 +1,29 @@
 """Pallas TPU kernel for the MQ arithmetic coder (codec/cxd.py).
 
-The second hand-written kernel: one code-block per grid cell, the
-block's CX/D symbol buffer lands in VMEM and the kernel runs the same
-per-symbol MQ encode step the jnp path scans with (``cxd._make_mq_step``
-— shared verbatim, so the two implementations cannot drift), carrying
-the A/C/CT registers, the 19 per-context Qe/MPS states, the byte buffer
-and the per-pass truncation snapshots through a ``lax.fori_loop``, then
-flushing. Only the finished byte segments leave the core — the MQ
-state machine never touches the host.
+One code-block per grid cell: the block's CX/D symbol buffer lands in
+VMEM and the kernel runs the same MQ_UNROLL-symbol trip the jnp path
+scans with (``cxd._mq_chunk_step`` — shared verbatim through the
+scalar ``ops`` seam, so the two implementations cannot drift),
+carrying the A/C/CT registers, the outstanding ``pending`` byte, the
+19 per-context Qe/MPS states, the byte buffer and the per-pass
+truncation snapshots through a ``lax.fori_loop``, then flushing.
+Renormalization is the arithmetic shift-count form (no per-shift
+loop, at most three masked byteouts per symbol). Only the finished
+byte segments leave the core — the MQ state machine never touches the
+host.
 
-VMEM working set per block: the symbol buffer (``n_steps`` bytes, pow-2
-bucketed to the chunk's realized maximum), the byte buffer
-(``mq_capacity(n_steps)`` ~ ``n_steps/2``), the (47, 4) Qe table and
-~200 B of registers/context state — comfortably resident for every
-bucket up to the full ``max_syms(P)``.
+The production device-MQ path runs this step *fused* behind the CX/D
+scan (pallas/fused_t1.py, ``cxd.fused_program``) so the symbol buffer
+never exists in HBM; this standalone kernel remains the per-block
+parity/oracle surface (tests/test_mq_device.py) and the direct
+counterpart of ``cxd._mq_run``.
 
 Status: semantics are locked to the jnp path by interpret-mode parity
-tests (tests/test_mq_device.py) on every CI run, and the device audit
-lowers the interpret-mode program on CPU per PR (``cxd.mq_program(...,
-pallas=True, interpret=True)``). On hardware the kernel is selected by
-the same ``BUCKETEER_CXD_PALLAS`` gate as the CX/D kernel, behind the
-Mosaic capability probe (support.py) that downgrades to the jnp scan —
-with a logged reason and a metrics counter — on backends whose plugin
-cannot compile Pallas programs.
+tests on every CI run. On hardware the kernel is selected by the same
+``BUCKETEER_CXD_PALLAS`` gate as the CX/D kernel, behind the Mosaic
+capability probe (support.py) that downgrades to the jnp scan — with a
+logged reason and a metrics counter — on backends whose plugin cannot
+compile Pallas programs.
 """
 from __future__ import annotations
 
@@ -42,55 +43,63 @@ from .. import cxd
 from .cxd_scan import _tpu_params
 
 
-def _kernel(P: int, n_steps: int, cap: int,
+def _mq_block(L: int, n_steps: int, cap: int, syms, counts, total,
+              flag, qe_tab):
+    """One block's MQ scan with the scalar ops — shared by this kernel
+    and the fused kernel's back half."""
+    ops = cxd._mq_ops(batched=False)
+    carry = cxd._mq_state(ops, (), L, cap)
+    carry = lax.fori_loop(
+        0, n_steps // cxd.MQ_UNROLL,
+        lambda t, cr: cxd._mq_chunk_step(ops, qe_tab, cap, syms, counts,
+                                         total, t * cxd.MQ_UNROLL, cr),
+        carry)
+    return cxd._mq_flush(ops, carry, flag != 0, cap)
+
+
+def _kernel(L: int, n_steps: int, cap: int,
             sym_ref, meta_ref, counts_ref, qe_ref,
             buf_ref, snaps_ref, dlen_ref, cur_ref):
     syms = sym_ref[0]
     counts = counts_ref[0]
     total, flag = meta_ref[0, 0], meta_ref[0, 1]
-    step = cxd._make_mq_step(cap, syms, total, counts,
-                             tables=(qe_ref[:],))
-
-    def body(t, carry):
-        return step(carry, t)[0]
-
-    carry = lax.fori_loop(0, n_steps, body, cxd._mq_init(P, cap))
-    buf, snaps, dlen, cur = cxd._mq_flush(carry, flag != 0, cap)
+    buf, snaps, dlen, cur = _mq_block(L, n_steps, cap, syms, counts,
+                                      total, flag, qe_ref[:])
     buf_ref[0] = buf
     snaps_ref[0] = snaps
     dlen_ref[0, 0] = dlen
     cur_ref[0, 0] = cur
 
 
-def mq_pallas(P: int, n_steps: int, cap: int, buf, counts, totals, flags,
+def mq_pallas(L: int, n_steps: int, cap: int, buf, counts, totals, flags,
               interpret: bool = False):
-    """Drop-in replacement for the vmapped jnp MQ scan:
-    (N, max_syms) uint8 symbols + (N, P, 3) pass cursors + (N,) totals
-    and flush flags -> (bytebuf (N, cap) uint8, snaps (N, P, 3) int32,
-    dlen (N,) int32, cursors (N,) int32)."""
+    """Drop-in replacement for the batched jnp MQ scan
+    (``cxd._mq_run``): (N, S) uint8 symbols + (N, L, 3) pass cursors +
+    (N,) totals and flush flags -> (bytebuf (N, cap) uint8,
+    snaps (N, L, 3) int32, dlen (N,) int32, cursors (N,) int32)."""
     n, msym = buf.shape
     meta = jnp.stack([totals, flags], axis=1).astype(jnp.int32)
     qe = jnp.asarray(cxd._QE_ARR)
     vmem = dict(memory_space=pltpu.VMEM) if pltpu is not None else {}
     smem = dict(memory_space=pltpu.SMEM) if pltpu is not None else {}
     bytebuf, snaps, dlen, cur = pl.pallas_call(
-        partial(_kernel, P, n_steps, cap),
+        partial(_kernel, L, n_steps, cap),
         grid=(n,),
         in_specs=[
             pl.BlockSpec((1, msym), lambda b: (b, 0), **vmem),
             pl.BlockSpec((1, 2), lambda b: (b, 0), **smem),
-            pl.BlockSpec((1, P, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, L, 3), lambda b: (b, 0, 0), **vmem),
             pl.BlockSpec(qe.shape, lambda b: (0, 0), **vmem),
         ],
         out_specs=(
             pl.BlockSpec((1, cap), lambda b: (b, 0), **vmem),
-            pl.BlockSpec((1, P, 3), lambda b: (b, 0, 0), **vmem),
+            pl.BlockSpec((1, L, 3), lambda b: (b, 0, 0), **vmem),
             pl.BlockSpec((1, 1), lambda b: (b, 0), **smem),
             pl.BlockSpec((1, 1), lambda b: (b, 0), **smem),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((n, cap), jnp.uint8),
-            jax.ShapeDtypeStruct((n, P, 3), jnp.int32),
+            jax.ShapeDtypeStruct((n, L, 3), jnp.int32),
             jax.ShapeDtypeStruct((n, 1), jnp.int32),
             jax.ShapeDtypeStruct((n, 1), jnp.int32),
         ),

@@ -59,10 +59,13 @@ CONVERSION_TYPE = "bucketeer.conversion.type"
 # CX/D streams through the host MQ coder (codec/cxd.py). Truthy enables,
 # "0"/empty disables, absent defers to the BUCKETEER_DEVICE_CXD env.
 DEVICE_CXD = "bucketeer.tpu.device.cxd"
-# Full Tier-1 on device: chain the MQ arithmetic coder after the CX/D
-# scan so the host only assembles finished byte segments (codec/cxd.py
-# run_device_mq). Truthy enables, "0"/empty disables, absent defers to
-# the BUCKETEER_DEVICE_MQ env. Implies the CX/D split.
+# Full Tier-1 on device: the fused CX/D + MQ program, so the host only
+# assembles finished byte segments (codec/cxd.py run_device_mq). Truthy
+# enables, "0"/empty disables, absent defers to the BUCKETEER_DEVICE_MQ
+# env — whose default is "auto": on for the TPU backend only, off
+# everywhere else (on CPU the measured tier1_split shows the native
+# host replay beating the emulated device; other accelerators must
+# opt in explicitly until measured — docs/pipeline.md flag table).
 DEVICE_MQ = "bucketeer.tpu.device.mq"
 # JAX persistent compilation cache directory: repeated bench/server runs
 # reuse compiled XLA programs instead of recompiling at boot. Env analog:

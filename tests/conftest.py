@@ -27,6 +27,20 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Persistent XLA compilation cache for the test session: the stripe-
+# parallel Tier-1 programs cost ~20 s of XLA each, several tests compile
+# the same (L, shape) variants, and the deviceaudit session fixture
+# clears JAX's in-memory caches once (fingerprint reproducibility) —
+# with the disk cache, every recompile after that is a read, not a
+# rebuild. A fresh per-session directory keeps runs hermetic.
+import tempfile  # noqa: E402
+
+from bucketeer_tpu.converters.tpu import (  # noqa: E402
+    maybe_enable_compile_cache)
+
+maybe_enable_compile_cache(
+    tempfile.mkdtemp(prefix="bucketeer-test-xla-cache-"))
+
 # Async HTTP-API tests (tests/test_api.py) run on aiohttp's pytest plugin.
 pytest_plugins = ("aiohttp.pytest_plugin",)
 
@@ -34,3 +48,54 @@ pytest_plugins = ("aiohttp.pytest_plugin",)
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(20260729)
+
+
+@pytest.fixture(scope="session")
+def repo_facts(tmp_path_factory):
+    """One full registry lowering per test session, shared by
+    test_deviceaudit and test_graftcost — run in a subprocess.
+    Lowering every registered program costs ~half a minute of tracing,
+    and ``deviceaudit.run_programs`` deliberately clears JAX's global
+    caches first (fingerprint reproducibility): in-process that would
+    force every later test's already-compiled programs to rebuild, so
+    the lowering happens in its own interpreter and ships its facts
+    back as a pickle (pure data: lowered text + modeled costs)."""
+    import pickle
+    import subprocess
+    import sys
+
+    out = tmp_path_factory.mktemp("audit") / "facts.pkl"
+    # Same write-back dance as this file's header: sitecustomize may
+    # set jax_platforms via jax.config, which overrides the env var —
+    # the child must force CPU through the config too.
+    script = (
+        "import os, pickle, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from bucketeer_tpu.analysis import deviceaudit\n"
+        "pickle.dump(deviceaudit.run_programs(),\n"
+        "            open(sys.argv[1], 'wb'))\n")
+    subprocess.run([sys.executable, "-c", script, str(out)], check=True,
+                   env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    return pickle.loads(out.read_bytes())
+
+
+@pytest.fixture()
+def cached_lowering(repo_facts, monkeypatch):
+    """Patch deviceaudit.run_programs to replay the session's lowering
+    — for CLI tests that exercise argument handling and gating, not
+    the lowering itself (each real invocation re-lowers the registry
+    *and* nukes the compile caches the rest of the suite relies on)."""
+    import copy
+
+    from bucketeer_tpu.analysis import deviceaudit
+
+    def replay(entries=None):
+        wanted = (None if entries is None
+                  else {e.name for e in entries})
+        return [copy.deepcopy(f) for f in repo_facts
+                if wanted is None or f.name in wanted]
+
+    monkeypatch.setattr(deviceaudit, "run_programs", replay)
+    return repo_facts

@@ -28,8 +28,7 @@ P_TEST = 5          # one compiled scan shared by every unit trial
 
 @pytest.fixture(scope="module")
 def cxd_single():
-    xs = jnp.asarray(cxd.scan_xs(P_TEST))
-    return jax.jit(partial(cxd._cxd_single, P_TEST, 0, xs))
+    return jax.jit(partial(cxd._cxd_single, P_TEST, 0))
 
 
 def _random_block(rng, h, w, max_bits=P_TEST, density=0.3):
@@ -102,6 +101,79 @@ def _check_block(cxd_single, mags, negs, band, floor):
         assert got.bitplane == want.bitplane
 
 
+def test_eff_group_partitioner():
+    """The Mb clamp's launch planner: dead blocks (all-zero, or floored
+    away) join no group, live blocks bucket by pow-2 of their realized
+    plane depth, and tiny groups merge into the next larger bucket."""
+    nbps = np.array([0, 5, 5, 1, 3, 3, 3, 3, 8], np.int32)
+    floors = np.array([0, 5, 1, 0, 0, 0, 0, 0, 0], np.int32)
+    groups, eff = cxd._eff_groups(nbps, floors)
+    np.testing.assert_array_equal(eff, [0, 0, 4, 1, 3, 3, 3, 3, 8])
+    by_l = {l: list(i) for l, i in groups}
+    covered = sorted(i for idxs in by_l.values() for i in idxs)
+    assert covered == [2, 3, 4, 5, 6, 7, 8]     # 0 and 1 are dead
+    # eff 1..8 all land in the smallest launch bucket.
+    assert by_l == {8: [2, 3, 4, 5, 6, 7, 8]}
+    for l_val, idxs in groups:
+        assert l_val in cxd.LAUNCH_PLANE_BUCKETS
+        assert len(idxs) >= cxd.GROUP_MIN_BLOCKS or l_val == max(by_l)
+        assert all(eff[i] <= l_val for i in idxs)
+    # A deeper block splits off its own bucket once populated.
+    nbps2 = np.array([3, 3, 3, 3, 12, 12, 12, 12], np.int32)
+    groups2, eff2 = cxd._eff_groups(nbps2, np.zeros(8, np.int32))
+    assert {l: list(i) for l, i in groups2} == \
+        {8: [0, 1, 2, 3], 16: [4, 5, 6, 7]}
+    # The bucket mapper itself.
+    assert [cxd._launch_bucket(e) for e in (1, 4, 5, 9, 17)] == \
+        [8, 8, 8, 16, 32]
+    with pytest.raises(ValueError):
+        cxd._launch_bucket(33)
+
+
+def test_sparse_mb_clamped_chunk_byte_identical(rng, cxd_single):
+    """Mb-clamped sparse cases through the full grouped chunk path:
+    all-zero blocks and floored-dead blocks launch nothing, a
+    single-significant-coefficient block rides the smallest bucket,
+    and every live block replays byte-identical to the reference."""
+    n = 6
+    blocks = np.zeros((n, 64, 64), np.int32)
+    metas = []
+    bands = ["LL", "HH", "HL", "LH", "LL", "HH"]
+    for i, maxb in enumerate((P_TEST, 1, 2, P_TEST, P_TEST, 3)):
+        h = int(rng.integers(1, 65))
+        w = int(rng.integers(1, 65))
+        mags, negs = _random_block(rng, h, w, max_bits=maxb)
+        if i == 1:
+            mags[:] = 0
+            mags[h // 2, w // 2] = 1        # single significant sample
+        if i == 4:
+            mags[:] = 0                     # all-zero block
+        blocks[i, :h, :w] = mags.astype(np.int64) * np.where(negs, -1, 1)
+        metas.append((mags, negs, bands[i], h, w))
+    nbps = np.array([int(m.max()).bit_length() for m, *_ in metas],
+                    np.int32)
+    floors = np.array([0, 0, 0, P_TEST, 0, 1], np.int32)  # 3: dead
+    hs = np.array([m[3] for m in metas], np.int32)
+    ws = np.array([m[4] for m in metas], np.int32)
+    groups, eff = cxd._eff_groups(nbps, floors)
+    grouped = {i for _, idxs in groups for i in idxs}
+    assert 3 not in grouped and 4 not in grouped    # zero trips
+    streams = cxd.run_cxd(jnp.asarray(blocks), nbps, floors, bands,
+                          hs, ws, P_TEST, 0)
+    got = t1_batch.encode_cxd(streams)
+    for i, (mags, negs, band, h, w) in enumerate(metas):
+        floor = int(floors[i])
+        if nbps[i] <= floor:
+            assert got[i].data == b"" and not got[i].passes
+            continue
+        mags_f = (mags >> floor) << floor
+        ref_blk, _, _ = cxd.reference_cxd(mags_f, negs, band, floor)
+        assert got[i].data == ref_blk.data, f"block {i}"
+        for gp, rp in zip(got[i].passes, ref_blk.passes):
+            assert gp.cum_length == rp.cum_length
+            assert gp.dist_reduction == rp.dist_reduction
+
+
 def test_pack6_roundtrip(rng):
     syms = rng.integers(0, 64, size=512).astype(np.uint8)
     packed = np.asarray(cxd.pack6(jnp.asarray(syms[None])))[0]
@@ -163,30 +235,31 @@ def test_python_fallback_replay_matches(rng, monkeypatch):
     assert got[0].data == ref_blk.data
 
 
-def test_pallas_kernel_matches_jnp_scan(rng, cxd_single):
-    """The Pallas kernel (interpret mode on CPU) and the vmapped
-    lax.scan share one step function; prove their outputs are
-    bit-identical anyway — buffer, counts, cursors, distortions."""
+def test_pallas_kernel_matches_jnp_scan(rng):
+    """The Pallas kernel (interpret mode on CPU) and the vmapped scan
+    share one scan body; prove their outputs are bit-identical anyway —
+    buffer, counts, cursors, distortions. Kept at L=2: interpret mode
+    executes every trip through the Python interpreter, so trip count
+    is this test's wall clock."""
     from bucketeer_tpu.codec.pallas.cxd_scan import cxd_pallas
 
+    L = 2
     n = 2
     blocks = np.zeros((n, 64, 64), np.int32)
     for i in range(n):
-        mags, negs = _random_block(rng, 64, 64, density=0.2)
+        mags, negs = _random_block(rng, 64, 64, max_bits=L, density=0.2)
         blocks[i] = mags.astype(np.int64) * np.where(negs, -1, 1)
     nbps = np.array([int(np.abs(blocks[i]).max()).bit_length()
                      for i in range(n)], np.int32)
     floors = np.array([0, 1], np.int32)
     cls = np.array([0, 2], np.int32)
     hw = np.full(n, 64, np.int32)
-    ref = [np.asarray(a) for a in jax.vmap(
-        lambda *a: cxd_single(*a))(
-        jnp.asarray(blocks), jnp.asarray(nbps), jnp.asarray(floors),
-        jnp.asarray(cls), jnp.asarray(hw), jnp.asarray(hw))]
-    got = [np.asarray(a) for a in cxd_pallas(
-        P_TEST, 0, jnp.asarray(blocks), jnp.asarray(nbps),
-        jnp.asarray(floors), jnp.asarray(cls), jnp.asarray(hw),
-        jnp.asarray(hw), interpret=True)]
+    args = (jnp.int32(0), jnp.asarray(blocks), jnp.asarray(nbps),
+            jnp.asarray(floors), jnp.asarray(cls), jnp.asarray(hw),
+            jnp.asarray(hw))
+    ref = [np.asarray(a)
+           for a in jax.jit(cxd._scan_impl(L, False, False))(*args)]
+    got = [np.asarray(a) for a in cxd_pallas(L, *args, interpret=True)]
     for g, r in zip(got, ref):
         np.testing.assert_array_equal(g, r)
 

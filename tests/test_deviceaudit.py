@@ -20,11 +20,6 @@ REPO = Path(__file__).resolve().parent.parent
 MANIFEST = REPO / ".graftaudit-manifest.json"
 
 
-@pytest.fixture(scope="session")
-def repo_facts():
-    return deviceaudit.run_programs()
-
-
 def _lowered(repo_facts):
     return [f for f in repo_facts if not f.skipped]
 
@@ -257,14 +252,15 @@ def test_stale_d2h_whitelist_entry_is_reported(tmp_path):
 
 # --- CLI ----------------------------------------------------------------
 
-def test_cli_audit_passes_on_repo(capsys):
+def test_cli_audit_passes_on_repo(capsys, cached_lowering):
     rc = cli_main([str(REPO / "bucketeer_tpu"), "--audit", "--strict",
                    "--baseline", str(REPO / ".graftlint-baseline.json"),
                    "--manifest", str(MANIFEST)])
     assert rc == 0, capsys.readouterr().out
 
 
-def test_cli_audit_fails_on_manifest_drift(tmp_path, capsys):
+def test_cli_audit_fails_on_manifest_drift(tmp_path, capsys,
+                                           cached_lowering):
     bad = tmp_path / "manifest.json"
     bad.write_text(json.dumps({"jax": "0", "programs": {
         "ghost/program": {"fingerprint": "x", "op_counts": {},

@@ -22,11 +22,6 @@ BASELINE = REPO / ".graftlint-baseline.json"
 MANIFEST = REPO / ".graftaudit-manifest.json"
 
 
-@pytest.fixture(scope="session")
-def repo_facts():
-    return deviceaudit.run_programs()
-
-
 def _lower(fn, *avals):
     import jax
 
@@ -219,24 +214,55 @@ def test_registry_programs_all_model(repo_facts):
     assert len(costs) >= 8
     for c in costs.values():
         assert c.hbm_bytes > 0, c.name
+        if c.name.split("/")[0].startswith("cxdmq.fused"):
+            # The fused program's MQ half runs to the *realized*
+            # symbol cursor — a dynamic while the static extractor
+            # reports as exactly one unknown trip count, on record.
+            assert c.unknown_trips == 1, c.name
+            continue
         assert c.unknown_trips == 0, (
             f"{c.name}: unreadable while trip count — the cost model "
             "lost the scan depth")
 
 
 def test_cxd_scan_trip_count_is_quantified(repo_facts):
-    """The acceptance number: the CX/D scan's sequential trip count is
-    P * 3 passes * 16 stripes * 64 columns = 6144 at the audit bucket
-    (P=2), and the MQ scan is per-symbol (1024 bucketed steps). These
-    are the ROADMAP elephant, pinned statically."""
+    """The acceptance number, flipped: the stripe-parallel scan's trip
+    counts at the audit bucket (L=2) are COL_TRIPS for the peeled
+    first plane plus 3 * COL_TRIPS for the second — a >= 4x cut from
+    the old P * 3 * 1024 = 6144 — and no single while reaches the
+    per-element threshold (1024) any more."""
+    from bucketeer_tpu.codec import cxd
+
     costs = {c.name.split("/")[0]: c for c in _costs(repo_facts)}
-    assert costs["cxd.scan"].max_trip == 2 * 3 * 16 * 64
-    assert costs["cxd.scan.raw"].max_trip == 2 * 3 * 16 * 64
-    assert costs["mq.scan"].max_trip == 1024
-    # Scans dominate their modeled time on every machine model.
-    for fam in ("cxd.scan", "mq.scan"):
-        for m in graftcost.MACHINES.values():
-            assert costs[fam].roofline(m)["bound"] == "sequential"
+    want_depth = cxd.COL_TRIPS + 3 * cxd.COL_TRIPS
+    for fam in ("cxd.scan", "cxd.scan.pallas"):
+        assert costs[fam].max_trip == cxd.COL_TRIPS, fam
+        assert costs[fam].scan_depth == want_depth, fam
+        assert costs[fam].scan_depth * 4 <= 2 * 3 * 16 * 64
+    # The fused program carries the same static CX/D depth plus its
+    # one dynamic MQ while (counted as a single trip).
+    assert costs["cxdmq.fused"].max_trip == cxd.COL_TRIPS
+    assert costs["cxdmq.fused"].scan_depth == want_depth + 1
+    # The remaining trips still dominate the modeled time at the tiny
+    # audit bucket; what changed is the floor, not the classification.
+    for m in graftcost.MACHINES.values():
+        assert costs["cxd.scan"].roofline(m)["bound"] == "sequential"
+
+
+def test_fused_chain_cuts_modeled_traffic(repo_facts):
+    """The fused program's modeled HBM bytes must undercut the sum of
+    what the old two-program chain paid for the symbol-buffer
+    round-trip: the buffer (max_syms(2) bytes per block) is internal
+    now, so fused I/O carries no (N, max_syms) result."""
+    from bucketeer_tpu.codec import cxd
+
+    costs = {c.name.split("/")[0]: c for c in _costs(repo_facts)}
+    fused = costs["cxdmq.fused"]
+    # No program output is the symbol buffer.
+    assert cxd.max_syms(2) not in fused.output_sizes
+    # And the scan's modeled traffic dropped far past the 2x bar the
+    # acceptance sets for the hand-off.
+    assert fused.hbm_bytes * 2 < 140_000_000
 
 
 def test_transform_and_inverse_are_memory_bound(repo_facts):
@@ -249,20 +275,22 @@ def test_transform_and_inverse_are_memory_bound(repo_facts):
 
 # --- perf rules + baseline hygiene -------------------------------------
 
-def test_perf_rules_fire_on_known_offenders(repo_facts):
+def test_perf_rules_after_the_stripe_parallel_cut(repo_facts):
+    """The scan-depth and round-trip findings are *resolved*, not
+    baselined: no per-element scan fires (every while sits under the
+    stripe-column threshold) and no chain round-trips the symbol
+    buffer (CHAINS is empty — the fused program keeps it on-chip).
+    What remains is the low-intensity debt on the Pallas kernels."""
     findings = rules_perf.run(_costs(repo_facts),
                               graftcost.MACHINES["tpu_v4"])
     by_rule: dict = {}
     for f in findings:
         by_rule.setdefault(f.rule, []).append(f)
-    scans = {f.path for f in by_rule[rules_perf.SCAN_PER_ELEMENT]}
-    assert any("cxd.scan" in p for p in scans)
-    assert any("mq.scan" in p for p in scans)
-    # The (N, max_syms) symbol buffer round-trip is on record.
-    rt = by_rule[rules_perf.HBM_ROUNDTRIP]
-    assert any("cxd.scan.raw" in f.path and "mq.scan" in f.path
-               for f in rt)
+    assert rules_perf.SCAN_PER_ELEMENT not in by_rule, (
+        by_rule.get(rules_perf.SCAN_PER_ELEMENT))
+    assert rules_perf.HBM_ROUNDTRIP not in by_rule
     low = by_rule[rules_perf.LOW_INTENSITY]
+    assert any("cxdmq.fused.pallas" in f.path for f in low)
     assert all(".pallas" in f.path for f in low)
     assert all(f.severity == "warning" for f in findings)
 
@@ -281,18 +309,19 @@ def test_known_offenders_are_baselined(repo_facts):
     assert missing == [], missing
 
 
-def test_cli_cost_strict_passes_on_repo(capsys):
+def test_cli_cost_strict_passes_on_repo(capsys, cached_lowering):
     rc = cli_main([str(REPO / "bucketeer_tpu"), "--cost", "--strict",
                    "--baseline", str(BASELINE)])
     out = capsys.readouterr().out
     assert rc == 0, out
     # The report lines carry flops/bytes/intensity/scan depth for the
-    # registered programs, including the quantified CX/D trip count.
-    assert "cxd.scan/P2/N1" in out and "scan depth 6144" in out
+    # registered programs, including the quantified CX/D trip count
+    # (COL_TRIPS + 3 * COL_TRIPS at the L=2 audit bucket).
+    assert "cxd.scan/L2/N1" in out and "scan depth 1024" in out
     assert "intensity" in out and "MB HBM" in out and "MFLOP" in out
 
 
-def test_cli_cost_report_json(tmp_path, capsys):
+def test_cli_cost_report_json(tmp_path, capsys, cached_lowering):
     report = tmp_path / "cost.json"
     rc = cli_main([str(REPO / "bucketeer_tpu"), "--cost", "--machine",
                    "cpu", "--baseline", str(BASELINE),
@@ -301,15 +330,16 @@ def test_cli_cost_report_json(tmp_path, capsys):
     data = json.loads(report.read_text(encoding="utf-8"))
     assert data["machine"] == "cpu"
     progs = data["programs"]
-    assert "cxd.scan/P2/N1" in progs
-    entry = progs["cxd.scan/P2/N1"]
+    assert "cxd.scan/L2/N1" in progs
+    entry = progs["cxd.scan/L2/N1"]
     for key in ("flops", "hbm_bytes", "intensity", "scan_depth",
                 "peak_live_bytes", "roofline"):
         assert key in entry, key
     assert entry["roofline"]["bound"] == "sequential"
 
 
-def test_stale_perf_baseline_entry_fails_strict(tmp_path, capsys):
+def test_stale_perf_baseline_entry_fails_strict(tmp_path, capsys,
+                                                cached_lowering):
     """A fixed offender leaves a stale baseline line: --cost --strict
     must fail on it (same hygiene as every other rule), while a
     lint-only run must leave perf entries alone."""
@@ -370,7 +400,7 @@ def test_skipped_program_perf_entries_are_not_stale(tmp_path,
 
     hobbled = copy.deepcopy(repo_facts)
     for f in hobbled:
-        if f.name.startswith("mq.scan.pallas"):
+        if f.name.startswith("cxdmq.fused.pallas"):
             f.skipped = "synthetic: not lowerable here"
             f.cost = None
     monkeypatch.setattr(da, "run_programs",
@@ -389,7 +419,7 @@ def test_doubled_modeled_traffic_fails_drift_gate(repo_facts):
     doubles (same structural fingerprint or not) fails the manifest
     gate with one actionable line naming the field and the growth."""
     manifest = deviceaudit.manifest_from_facts(repo_facts)
-    name = "cxd.scan/P2/N1"
+    name = "cxd.scan/L2/N1"
     tampered = json.loads(json.dumps(manifest))
     tampered["programs"][name]["cost"]["hbm_bytes"] //= 2
     drift = deviceaudit.diff_manifest(tampered, manifest)
@@ -401,7 +431,7 @@ def test_doubled_modeled_traffic_fails_drift_gate(repo_facts):
 
 def test_cost_within_tolerance_is_not_drift(repo_facts):
     manifest = deviceaudit.manifest_from_facts(repo_facts)
-    name = "cxd.scan/P2/N1"
+    name = "cxd.scan/L2/N1"
     nudged = json.loads(json.dumps(manifest))
     cost = nudged["programs"][name]["cost"]
     cost["hbm_bytes"] = int(cost["hbm_bytes"] * 1.05)
@@ -414,7 +444,7 @@ def test_scan_depth_drift_is_reported(repo_facts):
     'stripe-column vectorization cut trip count 4x' shows up here as a
     scan_depth line — the claim is checkable without a TPU."""
     manifest = deviceaudit.manifest_from_facts(repo_facts)
-    name = "cxd.scan/P2/N1"
+    name = "cxd.scan/L2/N1"
     tampered = json.loads(json.dumps(manifest))
     tampered["programs"][name]["cost"]["scan_depth"] *= 4
     drift = deviceaudit.diff_manifest(tampered, manifest)
@@ -434,7 +464,8 @@ def test_checked_in_manifest_carries_cost_fingerprints():
 
 # --- the bench-calibration prediction ----------------------------------
 
-def test_tier1_prediction_shape():
+def test_tier1_prediction_shape(cached_lowering):
+    graftcost._PREDICTION_CACHE.clear()
     pred = graftcost.tier1_prediction()
     assert set(pred) == set(graftcost.MACHINES)
     for entry in pred.values():

@@ -26,7 +26,7 @@ The contract under test, layered:
    encode.t1_device_total segments.
 """
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -84,14 +84,20 @@ def _host_encode(syms, boundaries):
 _ORACLE_STEPS = 8192      # one shared compile for every oracle trial
 
 
+@lru_cache(maxsize=4)
+def _oracle_encoder(P, n_steps, cap):
+    """One jitted oracle per shape — a fresh jax.jit(partial(...)) per
+    call would recompile the scan for every trial."""
+    return jax.jit(partial(cxd._mq_single, P, n_steps, cap))
+
+
 def _device_encode(syms, counts, P=2):
     n = len(syms)
     assert n <= _ORACLE_STEPS
     cap = cxd.mq_capacity(_ORACLE_STEPS)
     symbuf = np.zeros(_ORACLE_STEPS, np.uint8)
     symbuf[:n] = syms
-    buf, snaps, dlen, cur = jax.jit(
-        partial(cxd._mq_single, P, _ORACLE_STEPS, cap))(
+    buf, snaps, dlen, cur = _oracle_encoder(P, _ORACLE_STEPS, cap)(
         jnp.asarray(symbuf), jnp.asarray(counts), jnp.int32(n),
         jnp.int32(1 if n else 0))
     buf = np.asarray(buf)
@@ -201,28 +207,71 @@ def test_run_device_mq_matches_replay(rng):
 
 
 def test_mq_pallas_interpret_matches_jnp(rng):
-    """The Pallas MQ kernel (interpret mode) and the vmapped lax.scan
-    share one step function; prove bit-identity anyway — byte buffer,
-    snapshots, data lengths, cursors."""
+    """The Pallas MQ kernel (interpret mode) and the batched jnp scan
+    share one chunk step through the ops seam; prove bit-identity
+    anyway — byte buffer, snapshots, data lengths, cursors."""
     from bucketeer_tpu.codec.pallas.mq_scan import mq_pallas
 
-    P, n_steps = 2, 1024
+    L, n_steps = 2, 1024
     cap = cxd.mq_capacity(n_steps)
-    msym = cxd.max_syms(P)
+    msym = cxd.max_syms(L)
     N = 3
     sym = (rng.integers(0, 19, (N, msym))
            | (rng.integers(0, 2, (N, msym)) << 5)).astype(np.uint8)
     totals = np.array([900, 0, 1024], np.int32)
     counts = np.stack([
-        np.sort(rng.integers(0, t + 1, P * 3)).reshape(P, 3)
+        np.sort(rng.integers(0, t + 1, L * 3)).reshape(L, 3)
         for t in totals]).astype(np.int32)
     flags = (totals > 0).astype(np.int32)
     args = (jnp.asarray(sym), jnp.asarray(counts), jnp.asarray(totals),
             jnp.asarray(flags))
-    ref = jax.vmap(lambda *a: cxd._mq_single(P, n_steps, cap, *a))(*args)
-    got = mq_pallas(P, n_steps, cap, *args, interpret=True)
+    ref = cxd._mq_run(L, n_steps, cap, *args)
+    got = mq_pallas(L, n_steps, cap, *args, interpret=True)
     for g, r in zip(got, ref):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+def test_fused_pallas_interpret_matches_jnp(rng):
+    """The fused CX/D->MQ Pallas kernel (interpret mode) equals the jnp
+    fused body bit for bit — byte rows, snapshots, data lengths,
+    distortion pairs, both cursors — including a dead padding block.
+    Kept at L=2 with sparse content: interpret mode executes every
+    trip through the Python interpreter, so trip count is the test's
+    wall clock."""
+    from bucketeer_tpu.codec.pallas.fused_t1 import fused_pallas
+
+    L = 2
+    n = 3
+    blocks = np.zeros((n, 64, 64), np.int32)
+    for i in range(2):
+        mags, negs = _random_block(rng, 64, 64, max_bits=L,
+                                   density=0.1)
+        blocks[i] = mags.astype(np.int64) * np.where(negs, -1, 1)
+    nbps = np.array([int(np.abs(blocks[i]).max()).bit_length()
+                     for i in range(n)], np.int32)
+    floors = np.array([0, 1, 1], np.int32)          # block 2: dead
+    cls = np.array([0, 2, 1], np.int32)
+    hw = np.full(n, 64, np.int32)
+    args = (jnp.int32(0), jnp.asarray(blocks), jnp.asarray(nbps),
+            jnp.asarray(floors), jnp.asarray(cls), jnp.asarray(hw),
+            jnp.asarray(hw))
+    # The reference composes the fused program from its two halves —
+    # the shared scan plus the batched MQ run over the full symbol
+    # capacity (live-masked trips beyond each block's cursor are
+    # identities, so this equals the fused dynamic-length loop) —
+    # instead of paying a third full-program compile.
+    buf, counts, dh, dl, cur = jax.jit(
+        cxd._scan_impl(L, False, False))(*args)
+    cap = cxd.mq_capacity(cxd.max_syms(L))
+    flags = jnp.asarray((nbps > floors).astype(np.int32))
+    bytebuf, snaps, dlen, curb = cxd._mq_run(
+        L, cxd.max_syms(L), cap, buf, counts, cur, flags)
+    ref = (np.asarray(bytebuf).reshape(-1, cxd.MQ_ROW_BYTES),
+           snaps, dlen, dh, dl, cur, curb)
+    got = fused_pallas(L, *args, interpret=True)
+    for k, (g, r) in enumerate(zip(got, ref)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r),
+                                      err_msg=f"output {k}")
 
 
 def test_e2e_device_mq_byte_identical_lossless(rng):
@@ -311,7 +360,7 @@ def test_pallas_probe_downgrades_instead_of_crashing(monkeypatch):
     sink = Metrics()
     monkeypatch.setattr(support, "_SINK", sink)
     assert cxd._use_pallas() is False
-    fn, donate = cxd.cxd_program(2, 0)      # builds the jnp impl
+    fn, donate = cxd.cxd_program(2)         # builds the jnp impl
     assert donate == ()
     assert sink.report()["counters"]["encode.pallas_downgrades"] >= 1
     # And the probe is honest the other way: a passing probe keeps the
@@ -336,27 +385,32 @@ def test_compiled_kernels_match_jnp_on_tpu(rng):
                      for i in range(2)], np.int32)
     zeros = np.zeros(2, np.int32)
     hw = np.full(2, 64, np.int32)
-    xs = jnp.asarray(cxd.scan_xs(P_TEST))
-    jref = jax.vmap(lambda *a: cxd._cxd_single(P_TEST, 0, xs, *a))(
-        jnp.asarray(blocks), jnp.asarray(nbps), jnp.asarray(zeros),
-        jnp.asarray(zeros), jnp.asarray(hw), jnp.asarray(hw))
-    jgot = cxd_pallas(P_TEST, 0, jnp.asarray(blocks), jnp.asarray(nbps),
-                      jnp.asarray(zeros), jnp.asarray(zeros),
-                      jnp.asarray(hw), jnp.asarray(hw))
+    frac = jnp.int32(0)
+    args = (frac, jnp.asarray(blocks), jnp.asarray(nbps),
+            jnp.asarray(zeros), jnp.asarray(zeros), jnp.asarray(hw),
+            jnp.asarray(hw))
+    jref = cxd._scan_impl(P_TEST, False, False)(*args)
+    jgot = cxd_pallas(P_TEST, *args)
     for g, r in zip(jgot, jref):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
 
     buf, counts = np.asarray(jref[0]), np.asarray(jref[1])
     totals = np.asarray(jref[4]).astype(np.int32)
-    n_steps = cxd._mq_steps_bucket(int(totals.max()), P_TEST)
+    n_steps = cxd.max_syms(P_TEST)
     cap = cxd.mq_capacity(n_steps)
     flags = np.ones(2, np.int32)
     margs = (jnp.asarray(buf), jnp.asarray(counts), jnp.asarray(totals),
              jnp.asarray(flags))
-    mref = jax.vmap(lambda *a: cxd._mq_single(
-        P_TEST, n_steps, cap, *a))(*margs)
+    mref = cxd._mq_run(P_TEST, n_steps, cap, *margs)
     mgot = mq_pallas(P_TEST, n_steps, cap, *margs)
     for g, r in zip(mgot, mref):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+    from bucketeer_tpu.codec.pallas.fused_t1 import fused_pallas
+    ffn, _ = cxd.fused_program(P_TEST, pallas=False)
+    fref = jax.jit(ffn)(*args[1:], frac)
+    fgot = fused_pallas(P_TEST, *args)
+    for g, r in zip(fgot, fref):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
 
 
