@@ -24,6 +24,18 @@ live in the baseline with full staleness hygiene. ``--cost-report``
 writes the machine-readable report; the cost fingerprints also join
 the manifest, where drift beyond tolerance fails ``--audit``.
 
+``--mesh-audit`` adds the sharded layer (graftmesh): every registered
+mesh program is lowered and *partitioned* under a forced 8-device
+host mesh (in a subprocess when this interpreter was not started
+under ``XLA_FLAGS=--xla_force_host_platform_device_count=8``), its
+collectives are parsed with exact per-device bytes and priced by the
+ring model, per-device peak live is read from the compiled memory
+analysis, and the ``shard-*`` rules (rules_shard) fire on implicit
+all-gathers, oversized replicated operands and dead mesh axes — with
+the same baseline/staleness hygiene as the perf rules. The collective
+histograms + ICI fingerprints live in the manifest's
+``mesh_programs`` section and drift beyond tolerance fails the run.
+
 ``--race`` adds the dynamic layer (graftrace): the scheduler scenario
 suite is executed under the controlled scheduler, exploring
 interleavings systematically (bounded preemptions) and by seeded
@@ -41,6 +53,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from pathlib import Path
 
@@ -102,6 +115,15 @@ def main(argv=None) -> int:
                         help="write the machine-readable cost report "
                              "(per-program modeled cost + roofline + "
                              "padding waste) to this JSON file")
+    parser.add_argument("--mesh-audit", action="store_true",
+                        help="static SPMD/collective audit "
+                             "(graftmesh): lower every registered "
+                             "sharded program under the forced "
+                             "8-device host mesh, parse the "
+                             "partitioned collectives with exact "
+                             "bytes, model the ICI roofline term, "
+                             "fire the shard-* rules and diff the "
+                             "mesh manifest section")
     parser.add_argument("--race", action="store_true",
                         help="explore scheduler/cache interleavings "
                              "under the graftrace controlled scheduler "
@@ -196,13 +218,30 @@ def main(argv=None) -> int:
         from . import deviceaudit
         facts = deviceaudit.run_programs()
 
+    mesh_facts = None
+    if args.mesh_audit:
+        from . import graftmesh
+        mesh_facts = graftmesh.run_mesh_programs()
+
     if args.write_manifest:
+        from . import graftmesh
         _, manifest, facts = deviceaudit.run_audit(manifest_path,
                                                    facts=facts)
+        old = deviceaudit.load_manifest(manifest_path)
+        if mesh_facts is not None:
+            manifest[graftmesh.MESH_MANIFEST_KEY] = \
+                graftmesh.mesh_manifest_from_facts(mesh_facts)
+        elif old and graftmesh.MESH_MANIFEST_KEY in old:
+            # Not re-lowered this run (--write-manifest without
+            # --mesh-audit): carry the checked-in mesh section over
+            # instead of silently dropping it.
+            manifest[graftmesh.MESH_MANIFEST_KEY] = \
+                old[graftmesh.MESH_MANIFEST_KEY]
         deviceaudit.write_manifest(manifest_path, manifest)
         print(f"wrote {len(manifest['programs'])} lowered program(s) "
-              f"to {manifest_path}")
-        for f in facts:
+              f"and {len(manifest.get(graftmesh.MESH_MANIFEST_KEY, {}))} "
+              f"mesh program(s) to {manifest_path}")
+        for f in facts + (mesh_facts or []):
             if f.skipped:
                 print(f"  skipped {f.name}: {f.skipped}")
         return 0
@@ -221,10 +260,14 @@ def main(argv=None) -> int:
     # them from a rewritten baseline; a cost run additionally exempts
     # entries naming programs this environment could not lower (the
     # same tolerance diff_manifest extends to skipped programs).
+    # shard-* entries get the identical treatment under --mesh-audit.
     perf_entries = baseline_entries_for_rules(baseline_path, "perf-")
+    shard_entries = baseline_entries_for_rules(baseline_path, "shard-")
     exempt_fps: set = set()
     if not args.cost:
         exempt_fps = {e["fingerprint"] for e in perf_entries}
+    if not args.mesh_audit:
+        exempt_fps |= {e["fingerprint"] for e in shard_entries}
 
     if args.cost:
         from . import graftcost, rules_perf
@@ -255,8 +298,53 @@ def main(argv=None) -> int:
                 print(f"graftcost: {len(skipped)} program(s) not "
                       f"lowerable here: {skipped}")
 
+    if args.mesh_audit:
+        from . import deviceaudit, graftcost, graftmesh, rules_shard
+        machine = graftcost.MACHINES[args.machine or
+                                     graftcost.DEFAULT_MACHINE]
+        # Shard findings go through the same baseline + staleness
+        # hygiene as the AST and perf rules.
+        for f in rules_shard.run(mesh_facts):
+            if f.fingerprint() in baseline:
+                used_baseline.add(f.fingerprint())
+                continue
+            findings.append(f)
+        mesh_skipped = [f.name for f in mesh_facts if f.skipped]
+        exempt_fps |= {e["fingerprint"] for e in shard_entries
+                       if any(name in str(e.get("path", ""))
+                              for name in mesh_skipped)}
+        lowered_mesh = [f for f in mesh_facts if not f.skipped]
+        if len(lowered_mesh) < 3:
+            findings.append(Finding(
+                graftmesh.MESH_DRIFT, "<graftmesh>", 1,
+                f"only {len(lowered_mesh)} mesh program(s) lowered — "
+                "the audit needs the registry to cover the sharded "
+                f"entry points (skipped: {mesh_skipped})", ERROR))
+        mesh_section = graftmesh.mesh_manifest_from_facts(mesh_facts)
+        for line in graftmesh.diff_mesh_manifest(
+                deviceaudit.load_manifest(manifest_path), mesh_section,
+                skipped=tuple(mesh_skipped)):
+            findings.append(Finding(
+                graftmesh.MESH_DRIFT, str(manifest_path), 1, line,
+                ERROR))
+        if not args.as_json:
+            for f in lowered_mesh:
+                print(graftmesh.render_mesh_line(f, machine))
+            if mesh_skipped:
+                print(f"graftmesh: {len(mesh_skipped)} program(s) not "
+                      f"lowerable here: {mesh_skipped}")
+        if findings and args.dump_dir:
+            dump = Path(args.dump_dir)
+            dump.mkdir(parents=True, exist_ok=True)
+            for f in mesh_facts:
+                if f.text:
+                    safe = re.sub(r"[^\w.\-]", "_", f.name)
+                    (dump / f"{safe}.partitioned.hlo.txt").write_text(
+                        f.text, encoding="utf-8")
+
     if args.write_baseline:
-        keep = () if args.cost else perf_entries
+        keep = list(() if args.cost else perf_entries)
+        keep += list(() if args.mesh_audit else shard_entries)
         write_baseline(baseline_path, findings, keep_entries=keep)
         print(f"wrote {len(findings) + len(keep)} finding(s) to "
               f"{baseline_path}")

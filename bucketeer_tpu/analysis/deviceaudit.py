@@ -405,7 +405,8 @@ def _cost_drift(old_cost: dict, new_cost: dict) -> list:
     """Per-field relative drifts beyond COST_DRIFT_TOLERANCE, as
     rendered fragments ("hbm_bytes 1.2e6 -> 2.6e6 (+117%)")."""
     frags = []
-    for key in ("flops", "hbm_bytes", "scan_depth", "peak_live_bytes"):
+    for key in ("flops", "hbm_bytes", "scan_depth", "peak_live_bytes",
+                "ici_bytes"):
         a, b = old_cost.get(key), new_cost.get(key)
         if a is None or b is None or a == b:
             continue

@@ -69,12 +69,23 @@ class MachineModel:
     ``while`` trip (loop dispatch/sync), the term that dominates
     per-symbol scans; ``vmem_bytes`` is the fast-memory budget a
     resident kernel must fit (TPU VMEM ~16 MB/core per the Pallas
-    guide; the CPU entry uses a last-level-cache proxy)."""
+    guide; the CPU entry uses a last-level-cache proxy).
+
+    ``ici_bandwidth`` (bytes/s, per device) and ``n_devices`` extend
+    the roofline to sharded programs (analysis/graftmesh.py): modeled
+    time becomes max(compute, HBM, ICI) where the ICI term is the
+    ring-model bytes each device moves over its links per launch. The
+    ``cpu`` entry models the forced 8-device host mesh whose "links"
+    are shared-memory copies — near-zero-cost, so a CPU mesh audit
+    ranks compute/HBM exactly like the single-device one while still
+    pricing the collectives it finds."""
     name: str
     peak_flops: float        # sustained vector flop/s (not MXU bf16)
     hbm_bytes_per_s: float
     vmem_bytes: int
     seq_step_s: float
+    ici_bandwidth: float = 0.0   # per-device link bytes/s; 0 = no mesh
+    n_devices: int = 1           # devices in the modeled mesh
 
     def ridge(self) -> float:
         """Arithmetic intensity (flop/byte) where the roofline bends."""
@@ -85,11 +96,18 @@ MACHINES = {
     "tpu_v4": MachineModel("tpu_v4", peak_flops=4.0e12,
                            hbm_bytes_per_s=1.2e12,
                            vmem_bytes=16 * 1024 * 1024,
-                           seq_step_s=1.0e-6),
+                           seq_step_s=1.0e-6,
+                           # ~ring bandwidth per chip over the 3D-torus
+                           # ICI links; one v4 host = 4 chips.
+                           ici_bandwidth=9.0e10, n_devices=4),
     "cpu": MachineModel("cpu", peak_flops=1.0e11,
                         hbm_bytes_per_s=3.0e10,
                         vmem_bytes=32 * 1024 * 1024,
-                        seq_step_s=5.0e-6),
+                        seq_step_s=5.0e-6,
+                        # The forced host mesh: "links" are memcpys
+                        # through shared memory, effectively free next
+                        # to the compute/HBM terms.
+                        ici_bandwidth=1.0e12, n_devices=8),
 }
 DEFAULT_MACHINE = "tpu_v4"
 
@@ -607,6 +625,9 @@ class CostFacts:
     input_bytes: int = 0
     output_bytes: int = 0
     output_sizes: tuple = ()       # per-result bytes of ``main``
+    ici_bytes: int = 0             # per-device ring-model link bytes
+                                   # (graftmesh sets this from the
+                                   # partitioned HLO's collectives)
 
     @property
     def intensity(self) -> float:
@@ -615,15 +636,19 @@ class CostFacts:
     def roofline(self, machine: MachineModel) -> dict:
         t_compute = self.flops / machine.peak_flops
         t_memory = self.hbm_bytes / machine.hbm_bytes_per_s
+        t_ici = (self.ici_bytes / machine.ici_bandwidth
+                 if machine.ici_bandwidth else 0.0)
         t_seq = self.scan_depth * machine.seq_step_s
-        if t_seq > max(t_compute, t_memory):
+        if t_seq > max(t_compute, t_memory, t_ici):
             bound = "sequential"
+        elif t_ici > max(t_compute, t_memory):
+            bound = "ici"
         elif t_memory >= t_compute:
             bound = "memory"
         else:
             bound = "compute"
         return {"machine": machine.name,
-                "time_s": max(t_compute, t_memory) + t_seq,
+                "time_s": max(t_compute, t_memory, t_ici) + t_seq,
                 "bound": bound,
                 "intensity": round(self.intensity, 4),
                 "ridge": round(machine.ridge(), 4),
@@ -633,11 +658,17 @@ class CostFacts:
         """The cost fingerprint joining ``.graftaudit-manifest.json``
         (deviceaudit.manifest_from_facts). A pure function of the
         lowered text — reproducible from any entry point."""
-        return {"flops": self.flops, "hbm_bytes": self.hbm_bytes,
-                "scan_depth": self.scan_depth,
-                "max_trip": self.max_trip,
-                "peak_live_bytes": self.peak_live_bytes,
-                "intensity": round(self.intensity, 4)}
+        entry = {"flops": self.flops, "hbm_bytes": self.hbm_bytes,
+                 "scan_depth": self.scan_depth,
+                 "max_trip": self.max_trip,
+                 "peak_live_bytes": self.peak_live_bytes,
+                 "intensity": round(self.intensity, 4)}
+        if self.ici_bytes:
+            # Only sharded programs carry interconnect traffic; keeping
+            # the key off single-device entries leaves the checked-in
+            # manifest byte-stable for them.
+            entry["ici_bytes"] = self.ici_bytes
+        return entry
 
 
 def cost_program(text: str, name: str = "<program>") -> CostFacts:
@@ -757,8 +788,10 @@ def cost_report(all_facts: list, machine: MachineModel,
 
 def render_cost_line(c: CostFacts, machine: MachineModel) -> str:
     roof = c.roofline(machine)
+    comms = (f"{c.ici_bytes / 1e6:.3g} MB ICI, " if c.ici_bytes
+             else "")
     return (f"{c.name}: {c.flops / 1e6:.3g} MFLOP, "
-            f"{c.hbm_bytes / 1e6:.3g} MB HBM, "
+            f"{c.hbm_bytes / 1e6:.3g} MB HBM, {comms}"
             f"intensity {roof['intensity']:.3g} flop/B, "
             f"scan depth {c.scan_depth}, {roof['bound']}-bound "
             f"({machine.name}: {roof['time_s'] * 1e6:.3g} us)")

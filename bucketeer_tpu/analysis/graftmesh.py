@@ -1,0 +1,533 @@
+"""graftmesh: static SPMD/collective audit of the sharded programs.
+
+deviceaudit lowers the single-device registry; this layer does the
+same audit-before-build play for the *sharded* seams (parallel/) that
+ROADMAP item 2's device-pool data plane will grow on. Every registered
+mesh program — the row-sharded DWT behind ``sharded_transform_tile``,
+the ``run_tiles_sharded`` data-parallel transform, and the sharded
+variants of the fused Tier-1 program built through the existing
+``*_program`` seams — is lowered under a forced 8-device host mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``; a subprocess
+when the current interpreter was not started under that flag, exactly
+like the PR 15 registry lowering in tests/conftest.py, because the XLA
+device count is fixed at backend init) and the **partitioned** HLO is
+audited:
+
+- **collectives, with exact bytes** — every ``all-reduce`` /
+  ``all-gather`` / ``reduce-scatter`` / ``collective-permute`` /
+  ``all-to-all`` instruction is parsed with its per-device operand
+  bytes (compiled shapes are already per-shard) and replica-group
+  size, and priced by the ring model: the bytes each device moves over
+  its ICI links per launch. The per-program collective histogram and
+  total ICI bytes join ``.graftaudit-manifest.json`` under
+  ``"mesh_programs"`` and are diffed in CI exactly like single-device
+  drift — a change that doubles modeled ICI traffic fails the gate
+  with no hardware run (tolerance: deviceaudit.COST_DRIFT_TOLERANCE).
+- **per-device peak live bytes** — ``compiled.memory_analysis()``
+  (argument + output + temp, all per-device) against the machine's
+  VMEM budget, the number the single-device model cannot see.
+- **roofline with a comms term** — the unpartitioned StableHLO runs
+  through graftcost as usual and the parsed ICI bytes land in
+  ``CostFacts.ici_bytes``, so modeled time is max(compute, HBM, ICI)
+  (``MachineModel.ici_bandwidth`` / ``n_devices``).
+
+Findings over these facts live in :mod:`rules_shard`
+(``shard-implicit-allgather`` / ``shard-replicated-large`` /
+``shard-axis-dead``), driven by ``python -m bucketeer_tpu.analysis
+--mesh-audit`` with the same baseline + staleness hygiene as the AST
+and perf rules.
+
+Ring-model ICI bytes per device for group size g (the standard
+bandwidth-optimal algorithms; collective-permute is point-to-point):
+
+| collective | per-device link bytes |
+|---|---|
+| all-gather | in × (g−1) |
+| reduce-scatter | in × (g−1)/g |
+| all-reduce | 2 × in × (g−1)/g |
+| all-to-all | in × (g−1)/g |
+| collective-permute | in |
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+from . import graftcost
+from .deviceaudit import COST_DRIFT_TOLERANCE
+
+MESH_DEVICES = 8
+MESH_MANIFEST_KEY = "mesh_programs"
+MESH_DRIFT = "shard-manifest-drift"
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+# One collective instruction in compiled HLO:
+#   %ag = f32[8]{0} all-gather(f32[2]{0} %x), replica_groups=...
+# Async pairs lower as -start/-done; the -start carries the operands,
+# so -done lines (no "(" straight after the base name) never match.
+_COLL_RE = re.compile(
+    r"=\s*[^=]*?\b(all-reduce|all-gather|reduce-scatter|"
+    r"collective-permute|all-to-all)(?:-start)?\(")
+_HLO_SHAPE_RE = re.compile(
+    r"\b(pred|bf16|f16|f32|f64|s4|s8|s16|s32|s64|u4|u8|u16|u32|u64|"
+    r"c64|c128)\[([\d,]*)\]")
+_HLO_DTYPE_BYTES = {"pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+                    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+                    "f32": 4, "s32": 4, "u32": 4,
+                    "f64": 8, "s64": 8, "u64": 8, "c64": 8, "c128": 16}
+# replica_groups comes literal ({{0,1},{2,3}}) or iota
+# ([num_groups,group_size]<=[...]); group size is what the ring model
+# needs from either.
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+_GROUPS_LITERAL_RE = re.compile(r"replica_groups=\{\{([\d,]+)\}")
+_REPL_PARAM_RE = re.compile(
+    r"=\s*(\S+)\s+parameter\((\d+)\).*sharding=\{replicated\}")
+
+
+def _shape_bytes(match: re.Match) -> int:
+    dims = match.group(2)
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _HLO_DTYPE_BYTES.get(match.group(1), 4)
+
+
+def _operand_section(line: str, start: int) -> str:
+    """The text inside the op's operand parens, honoring nesting
+    (tuple-typed operands of -start ops)."""
+    depth = 0
+    for i in range(start, len(line)):
+        if line[i] == "(":
+            depth += 1
+        elif line[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return line[start + 1:i]
+    return line[start + 1:]
+
+
+def ring_ici_bytes(kind: str, in_bytes: int, group: int) -> int:
+    """Per-device bytes over the interconnect for one collective,
+    under the bandwidth-optimal ring algorithms."""
+    if kind == "collective-permute":
+        return in_bytes
+    if group <= 1:
+        return 0
+    if kind == "all-gather":
+        return in_bytes * (group - 1)
+    if kind == "all-reduce":
+        return 2 * in_bytes * (group - 1) // group
+    # reduce-scatter and all-to-all move the same ring volume.
+    return in_bytes * (group - 1) // group
+
+
+def parse_collectives(hlo_text: str, n_devices: int = MESH_DEVICES) -> dict:
+    """Partitioned-HLO text -> {kind: {count, bytes_in, ici_bytes}}.
+
+    ``bytes_in`` sums the per-device operand bytes of every instance
+    (compiled shapes are per-shard already); ``ici_bytes`` applies the
+    ring model with the instruction's replica-group size (iota or
+    literal form; absent — collective-permute — the full mesh)."""
+    out: dict = {}
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group(1)
+        operands = _operand_section(line, m.end() - 1)
+        in_bytes = sum(_shape_bytes(s)
+                       for s in _HLO_SHAPE_RE.finditer(operands))
+        attrs = line[m.end():]
+        gm = _GROUPS_IOTA_RE.search(attrs)
+        if gm:
+            group = int(gm.group(2))
+        else:
+            gm = _GROUPS_LITERAL_RE.search(attrs)
+            group = (len(gm.group(1).split(",")) if gm else n_devices)
+        cell = out.setdefault(kind, {"count": 0, "bytes_in": 0,
+                                     "ici_bytes": 0})
+        cell["count"] += 1
+        cell["bytes_in"] += in_bytes
+        cell["ici_bytes"] += ring_ici_bytes(kind, in_bytes, group)
+    return out
+
+
+def parse_replicated_params(hlo_text: str) -> tuple:
+    """Entry parameters the partitioner left fully replicated, as
+    ((argnum, per_device_bytes), ...) — a replicated param costs its
+    whole global size on every device."""
+    found = []
+    for line in hlo_text.splitlines():
+        m = _REPL_PARAM_RE.search(line)
+        if m is None:
+            continue
+        sm = _HLO_SHAPE_RE.search(m.group(1))
+        nbytes = _shape_bytes(sm) if sm else 0
+        found.append((int(m.group(2)), nbytes))
+    return tuple(sorted(found))
+
+
+# --- the registry ---------------------------------------------------------
+
+@dataclass(frozen=True)
+class MeshProgram:
+    """One registered sharded program at one canonical mesh.
+
+    ``build() -> (fn, in_shardings, example_args)`` — the callable
+    comes from the owning module's ``*_program`` seam (pre-wrapped in
+    shard_map for the manual-partitioning entries), ``in_shardings``
+    is the tuple of NamedShardings the lowering pins (and the source
+    of the mesh shape + declared-axes facts the rules read), and
+    ``example_args`` are global-shape ShapeDtypeStructs.
+    ``expected_collectives`` names the kinds the program *declares*
+    (the DWT's halo ppermutes); anything else the partitioner inserts
+    is fair game for ``shard-implicit-allgather``."""
+    name: str
+    build: object
+    expected_collectives: tuple = ()
+
+
+@dataclass
+class MeshFacts:
+    """Partitioned-artifact facts for one audited mesh program. Pure
+    data — picklable across the subprocess lowering boundary."""
+    name: str
+    mesh_shape: dict = field(default_factory=dict)
+    axes_used: tuple = ()
+    expected_collectives: tuple = ()
+    fingerprint: str = ""          # sha256 of the unpartitioned
+                                   # StableHLO (stable, like deviceaudit)
+    collectives: dict = field(default_factory=dict)
+    ici_bytes: int = 0             # per-device ring-model total
+    peak_live_bytes: int = 0       # per-device arg+out+temp
+    replicated_args: tuple = ()    # ((argnum, bytes), ...)
+    cost: object = None            # graftcost.CostFacts (+ ici_bytes)
+    text: str = ""                 # partitioned HLO (for dumps)
+    skipped: str = ""
+
+
+def mesh_registry() -> list:
+    """The canonical audited mesh programs — every sharded execution
+    path the encoder ships, at the forced 8-device host mesh, sized to
+    the smallest shapes that exercise the real program structure."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..codec import cxd
+    from ..codec.pipeline import make_plan, transform_program
+    from ..parallel.compat import SM_NO_CHECK, shard_map
+    from ..parallel.mesh import DATA_AXIS, batch_sharding, make_mesh
+    from ..parallel.sharded_dwt import sharded_dwt_program
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    entries = []
+
+    # The device core of sharded_transform_tile / mesh-spatial encode:
+    # rows over the tile axis, halo exchange via lax.ppermute.
+    def dwt_entry(ndim, shape, reversible):
+        def build():
+            mesh = make_mesh(tile_parallel=MESH_DEVICES)
+            fn, spec = sharded_dwt_program(2, reversible, mesh, ndim)
+            return fn, (NamedSharding(mesh, spec),), [sds(shape,
+                                                          jnp.int32)]
+        return build
+
+    entries.append(MeshProgram(
+        "shard.dwt.tile/gray-rev-256x64-L2/T8",
+        dwt_entry(2, (256, 64), True),
+        expected_collectives=("collective-permute",)))
+    entries.append(MeshProgram(
+        "shard.dwt.tile/rgb-rev-256x64-L2/T8",
+        dwt_entry(3, (3, 256, 64), True),
+        expected_collectives=("collective-permute",)))
+
+    # The run_tiles_sharded path: the fused transform under GSPMD with
+    # the batch dimension on the data axis — tiles are independent, so
+    # a clean lowering has zero collectives; anything the partitioner
+    # inserts is a routing bug this audit exists to catch.
+    def transform_entry():
+        mesh = make_mesh(tile_parallel=1)
+        plan = make_plan(64, 64, 1, 2, True, 8)
+        fn, _donate = transform_program(plan)
+        return fn, (batch_sharding(mesh),), [sds((8, 64, 64, 1),
+                                                 jnp.int32)]
+    entries.append(MeshProgram(
+        "shard.transform.data/gray8-lossless-64x64-L2/B8",
+        transform_entry))
+
+    # The sharded variant of the fused Tier-1 program, through the
+    # existing cxd.fused_program seam: one block per device under
+    # manual data partitioning (shard_map via parallel.compat), the
+    # shape the device-pool data plane will launch.
+    def fused_entry():
+        mesh = make_mesh(tile_parallel=1)
+        fn, _donate = cxd.fused_program(2, pallas=False)
+        specs = (P(DATA_AXIS),) * 6 + (P(),)
+        sm = shard_map(fn, mesh=mesh, in_specs=specs,
+                       out_specs=P(DATA_AXIS), **SM_NO_CHECK)
+        ins = tuple(NamedSharding(mesh, s) for s in specs)
+        args = ([sds((8, 64, 64), jnp.int32)]
+                + [sds((8,), jnp.int32)] * 5 + [sds((), jnp.int32)])
+        return sm, ins, args
+    entries.append(MeshProgram("shard.cxdmq.fused.data/L2/N8",
+                               fused_entry))
+    return entries
+
+
+# --- lowering -------------------------------------------------------------
+
+def _axes_facts(in_shardings) -> tuple:
+    """(mesh_shape, axes_used) introspected from the NamedShardings the
+    program declares — the facts shard-axis-dead compares against."""
+    import jax
+
+    mesh_shape: dict = {}
+    axes: set = set()
+    for s in jax.tree_util.tree_leaves(in_shardings):
+        if not hasattr(s, "mesh"):
+            continue
+        mesh_shape = dict(s.mesh.shape)
+        for part in s.spec:
+            if part is None:
+                continue
+            if isinstance(part, (tuple, list)):
+                axes.update(part)
+            else:
+                axes.add(part)
+    return mesh_shape, tuple(sorted(axes))
+
+
+def lower_mesh_program(entry: MeshProgram) -> MeshFacts:
+    """Lower + partition one registered mesh program and extract its
+    collective/memory facts. Needs the forced host mesh in-process —
+    :func:`run_mesh_programs` owns the subprocess fallback."""
+    import jax
+
+    facts = MeshFacts(entry.name,
+                      expected_collectives=tuple(
+                          entry.expected_collectives))
+    try:
+        fn, in_shardings, args = entry.build()
+        facts.mesh_shape, facts.axes_used = _axes_facts(in_shardings)
+        lowered = jax.jit(fn, in_shardings=in_shardings).lower(*args)
+        stablehlo = lowered.as_text()
+        compiled = lowered.compile()
+        hlo = compiled.as_text()
+    except Exception as exc:  # pragma: no cover - env-dependent
+        facts.skipped = f"{type(exc).__name__}: {exc}"
+        return facts
+    n = 1
+    for size in facts.mesh_shape.values():
+        n *= size
+    facts.text = hlo
+    facts.fingerprint = hashlib.sha256(
+        stablehlo.encode("utf-8")).hexdigest()
+    facts.collectives = parse_collectives(hlo, n_devices=n or
+                                          MESH_DEVICES)
+    facts.ici_bytes = sum(c["ici_bytes"]
+                          for c in facts.collectives.values())
+    facts.replicated_args = parse_replicated_params(hlo)
+    try:
+        mem = compiled.memory_analysis()
+        facts.peak_live_bytes = int(mem.argument_size_in_bytes
+                                    + mem.output_size_in_bytes
+                                    + mem.temp_size_in_bytes)
+    except (AttributeError, NotImplementedError,
+            RuntimeError):  # pragma: no cover - backend-dependent
+        facts.peak_live_bytes = 0
+    facts.cost = graftcost.cost_program(stablehlo, entry.name)
+    facts.cost.ici_bytes = facts.ici_bytes
+    return facts
+
+
+def _cpu_device_count() -> int:
+    import jax
+
+    try:
+        return len([d for d in jax.devices() if d.platform == "cpu"])
+    except Exception:  # pragma: no cover - backend init failure
+        return 0
+
+
+def _run_inline(entries=None) -> list:
+    """Lower every registered mesh program in this process. Clears
+    jax's global caches first, for the same fingerprint-reproducibility
+    reason as deviceaudit.run_programs."""
+    import jax
+
+    jax.clear_caches()
+    return [lower_mesh_program(e)
+            for e in (mesh_registry() if entries is None else entries)]
+
+
+_CHILD_SCRIPT = """\
+import os, pickle, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from bucketeer_tpu.analysis import graftmesh
+pickle.dump(graftmesh._run_inline(), open(sys.argv[1], 'wb'))
+"""
+
+
+def _run_subprocess() -> list:
+    """The PR 15 pattern: the XLA device count is fixed at backend
+    init, so when this interpreter was not started under the forced
+    flag the lowering runs in a child that is — and ships its
+    MeshFacts back as a pickle (pure data)."""
+    import pickle
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="graftmesh-") as tmp:
+        out = os.path.join(tmp, "facts.pkl")
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD_SCRIPT, out],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "graftmesh subprocess lowering failed:\n"
+                + proc.stderr[-2000:])
+        with open(out, "rb") as fh:
+            return pickle.load(fh)
+
+
+def run_mesh_programs(entries=None, *, in_process=None) -> list:
+    """Lower every registered mesh program under the forced 8-device
+    host mesh; returns [MeshFacts]. Runs inline when this interpreter
+    already has the mesh (tests, the CI job with XLA_FLAGS exported),
+    else in a subprocess started under the flag. ``in_process=False``
+    forces the subprocess (the conftest fixture uses it so the inline
+    path's cache clearing never hits the test process)."""
+    if in_process is None:
+        in_process = _cpu_device_count() >= MESH_DEVICES
+    if in_process:
+        return _run_inline(entries)
+    if entries is not None:
+        raise ValueError("custom entries cannot cross the subprocess "
+                         "boundary; start this interpreter under "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=8 to lower them in-process")
+    return _run_subprocess()
+
+
+# --- manifest -------------------------------------------------------------
+
+def mesh_manifest_from_facts(all_facts: list) -> dict:
+    """The ``"mesh_programs"`` manifest section: per (program × mesh),
+    the structural fingerprint, collective histogram, modeled ICI
+    bytes and per-device peak live — the fingerprints CI diffs."""
+    programs = {}
+    for f in all_facts:
+        if f.skipped:
+            continue
+        entry = {
+            "fingerprint": f.fingerprint,
+            "mesh": dict(sorted(f.mesh_shape.items())),
+            "collectives": {k: dict(v) for k, v in
+                            sorted(f.collectives.items())},
+            "ici_bytes": f.ici_bytes,
+            "peak_live_bytes": f.peak_live_bytes,
+        }
+        if f.cost is not None:
+            entry["cost"] = f.cost.manifest_entry()
+        programs[f.name] = entry
+    return programs
+
+
+def diff_mesh_manifest(old: dict | None, new_programs: dict,
+                       skipped=()) -> list:
+    """Drift lines between the checked-in manifest's mesh section and
+    the freshly lowered one (empty = no drift). Same contract as
+    deviceaudit.diff_manifest: programs named in ``skipped`` are
+    tolerated missing; fingerprint changes, collective-histogram
+    changes, and modeled ICI / peak-live movement beyond
+    COST_DRIFT_TOLERANCE all fail — the doubled-ICI-traffic PR dies
+    here with no hardware run, while layout jitter under the tolerance
+    passes."""
+    import jax
+
+    if old is None or MESH_MANIFEST_KEY not in old:
+        return [f"no checked-in mesh section: {len(new_programs)} "
+                "sharded program(s) unaccounted — regenerate with "
+                "--mesh-audit --write-manifest and commit it"]
+    if old.get("jax") != jax.__version__:
+        return [f"manifest was generated under jax {old.get('jax')} "
+                f"but this environment runs jax {jax.__version__} — "
+                "lowered programs are version-specific; regenerate "
+                "with --write-manifest under the CI jax version"]
+    lines = []
+    olds = old[MESH_MANIFEST_KEY]
+    for name in sorted(set(olds) - set(new_programs) - set(skipped)):
+        lines.append(f"{name}: in the mesh manifest but no longer "
+                     "lowered (registry entry removed?)")
+    for name in sorted(set(new_programs) - set(olds)):
+        lines.append(f"{name}: lowered but absent from the mesh "
+                     "manifest (new sharded program — regenerate the "
+                     "manifest)")
+    for name in sorted(set(new_programs) & set(olds)):
+        o, n = olds[name], new_programs[name]
+        frags = []
+        for key in ("ici_bytes", "peak_live_bytes"):
+            a, b = o.get(key, 0), n.get(key, 0)
+            if a == b:
+                continue
+            rel = (b - a) / max(abs(a), 1)
+            if abs(rel) > COST_DRIFT_TOLERANCE:
+                frags.append(f"{key} {a:g} -> {b:g} ({rel:+.0%})")
+        if frags:
+            lines.append(
+                f"{name}: modeled mesh cost drifted beyond "
+                f"{COST_DRIFT_TOLERANCE:.0%} ({'; '.join(frags)}) — "
+                "a comms-relevant partitioned-program change; if "
+                "intentional, regenerate with --mesh-audit "
+                "--write-manifest and justify the new traffic in "
+                "review")
+            continue
+        oc = {k: v.get("count", 0)
+              for k, v in o.get("collectives", {}).items()}
+        nc = {k: v.get("count", 0)
+              for k, v in n.get("collectives", {}).items()}
+        if oc != nc:
+            deltas = [f"{k} {oc.get(k, 0)}->{nc.get(k, 0)}"
+                      for k in sorted(set(oc) | set(nc))
+                      if oc.get(k, 0) != nc.get(k, 0)]
+            lines.append(f"{name}: collective histogram drifted "
+                         f"({'; '.join(deltas)}) — the partitioner "
+                         "now emits different communication for this "
+                         "program")
+            continue
+        if o.get("fingerprint") != n["fingerprint"]:
+            lines.append(f"{name}: sharded program drifted "
+                         "(fingerprint changed; collective histogram "
+                         "and modeled mesh cost within tolerance)")
+    return lines
+
+
+def render_mesh_line(facts: MeshFacts,
+                     machine: graftcost.MachineModel) -> str:
+    """One human line per audited mesh program for the CLI output."""
+    n_coll = sum(c["count"] for c in facts.collectives.values())
+    mesh = "x".join(str(v) for _, v in sorted(facts.mesh_shape.items()))
+    head = (f"{facts.name} [mesh {mesh}]: {n_coll} collective(s), "
+            f"{facts.ici_bytes / 1e6:.3g} MB ICI/device, peak-live "
+            f"{facts.peak_live_bytes / 1e6:.3g} MB/device")
+    if facts.cost is None:
+        return head
+    roof = facts.cost.roofline(machine)
+    return (head + f", {roof['bound']}-bound ({machine.name}: "
+            f"{roof['time_s'] * 1e6:.3g} us)")

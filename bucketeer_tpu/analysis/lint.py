@@ -87,7 +87,10 @@ def _collect_aliases(mod: ModuleInfo) -> None:
                     elif alias.name in ("lax", "nn"):
                         mod.jax_aliases.add(name)
             elif node.module in ("jax.experimental.shard_map",
-                                 "jax.experimental"):
+                                 "jax.experimental") or (
+                    # parallel/compat.py re-exports jax's shard_map.
+                    node.module is not None
+                    and node.module.rsplit(".", 1)[-1] == "compat"):
                 for alias in node.names:
                     if alias.name == "shard_map":
                         mod.shardmap_names.add(alias.asname or alias.name)

@@ -1,0 +1,96 @@
+"""Sharding lint rules over graftmesh's partitioned-program facts.
+
+These fire on *anti-patterns in the partitioned artifacts* — what the
+GSPMD partitioner actually emitted for the forced 8-device host mesh,
+not what the Python declared. Like the perf rules, offenders would be
+carried in ``.graftlint-baseline.json`` with full staleness hygiene
+(the ``shard-`` prefix gets the same only-judged-when-run exemption
+``perf-`` has): a new offender fails the ``shard-audit`` CI job's
+``--strict``, a fixed one fails via its stale baseline entry until
+pruned.
+
+| rule | fires when |
+|---|---|
+| ``shard-implicit-allgather`` | the partitioner inserted an
+  ``all-gather`` the program never declared (not in the registry
+  entry's ``expected_collectives``) moving at least
+  ``ALLGATHER_MIN_BYTES`` per device — a sharding-constraint mismatch
+  silently resharding a large array over ICI. |
+| ``shard-replicated-large`` | an entry operand lowered
+  ``sharding={replicated}`` at or above ``REPLICATED_MIN_BYTES`` —
+  every device holds the full array, so per-device HBM pays the
+  global size (scalars and small tables are fine; a replicated tile
+  batch is the data plane failing to shard). |
+| ``shard-axis-dead`` | a mesh axis with more than one device appears
+  in none of the program's declared PartitionSpecs — devices assigned
+  to an axis that partitions nothing sit idle for the whole launch. |
+
+All three are warnings: modeled facts, not proven wall-clock bugs —
+but the ``shard-audit`` CI job runs ``--strict``, so unbaselined
+offenders fail the build.
+"""
+from __future__ import annotations
+
+from .findings import WARNING, Finding
+
+SHARD_IMPLICIT_ALLGATHER = "shard-implicit-allgather"
+SHARD_REPLICATED_LARGE = "shard-replicated-large"
+SHARD_AXIS_DEAD = "shard-axis-dead"
+
+# An undeclared gather below 1 MiB/device never dominates a launch;
+# above it the resharding is real ICI traffic somebody didn't plan.
+ALLGATHER_MIN_BYTES = 1 << 20
+
+# A replicated operand at/above 64 MiB costs every device the global
+# array — the "replicated 100 MB tile batch" failure mode.
+REPLICATED_MIN_BYTES = 64 << 20
+
+
+def _loc(name: str) -> str:
+    return f"<graftmesh:{name}>"
+
+
+def run(all_facts: list) -> list:
+    """Findings over a list of :class:`graftmesh.MeshFacts` (one per
+    lowered mesh-registry program). Pure — no lowering, no device."""
+    findings = []
+    for f in all_facts:
+        if getattr(f, "skipped", ""):
+            continue
+
+        for kind, cell in sorted(f.collectives.items()):
+            if kind != "all-gather" or kind in f.expected_collectives:
+                continue
+            if cell["ici_bytes"] < ALLGATHER_MIN_BYTES:
+                continue
+            findings.append(Finding(
+                SHARD_IMPLICIT_ALLGATHER, _loc(f.name), 0,
+                f"partitioner-inserted all-gather ({cell['count']} "
+                f"instruction(s), {cell['ici_bytes']} modeled ICI "
+                "bytes/device) that the program never declares — a "
+                "sharding-constraint mismatch is resharding a large "
+                "array over the interconnect; align the constraint "
+                "with the operand's sharding or declare the gather "
+                "in the registry entry", WARNING))
+
+        for argnum, nbytes in f.replicated_args:
+            if nbytes < REPLICATED_MIN_BYTES:
+                continue
+            findings.append(Finding(
+                SHARD_REPLICATED_LARGE, _loc(f.name), 0,
+                f"operand {argnum} is replicated at {nbytes} bytes "
+                "per device — every device holds the full array, so "
+                "per-device HBM pays the global size; shard it over "
+                "a mesh axis or shrink it below the threshold",
+                WARNING))
+
+        for axis, size in sorted(f.mesh_shape.items()):
+            if size > 1 and axis not in f.axes_used:
+                findings.append(Finding(
+                    SHARD_AXIS_DEAD, _loc(f.name), 0,
+                    f"mesh axis '{axis}' ({size} devices) partitions "
+                    "nothing in this program's declared shardings — "
+                    f"{size - 1}/{size} of the axis sits idle for "
+                    "the launch; fold the axis into one that is used "
+                    "or shard an operand over it", WARNING))
+    return findings

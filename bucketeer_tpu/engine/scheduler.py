@@ -48,8 +48,12 @@ access and host Tier-1 capacity instead:
 Observability (``set_metrics_sink``): ``encode.queue_wait`` /
 ``decode.queue_wait`` (stages), ``encode.batch_occupancy`` (value
 distribution: requests per device launch), and counters
-``{encode,decode}.admission_rejects``, ``encode.device_launches``,
+``{encode,decode}.admission_rejects``, ``encode.device_launches``
+(plus the per-device ``encode.device_launches.d<N>`` — one entry
+today; the ROADMAP item 2 device pool inherits the split for free),
 ``encode.batched_tiles``, ``{encode,decode}.deadline_expired``.
+Merged-launch spans carry a ``device_id`` attribute for the same
+reason.
 
 The pipeline-mapping trade-off this implements — shared replicated
 workers per stage versus per-request pipelines, throughput vs latency —
@@ -235,6 +239,11 @@ class EncodeScheduler:
 
         self._pool = ThreadPoolExecutor(max_workers=max(1, self.pool_size),
                                         thread_name_prefix="sched-t1")
+        # ROADMAP item 2 groundwork: one device loop today, so every
+        # merged launch lands on device 0 — but spans and counters
+        # already carry the id, so the pool refactor inherits
+        # per-device observability instead of retrofitting it.
+        self._device_id = 0
         self._lock = seam.make_lock("EncodeScheduler._lock")
         self._seq = itertools.count()
         self._waiting: list = []      # heap of (priority, seq, ticket)
@@ -621,7 +630,7 @@ class EncodeScheduler:
         # (the drift also lands as an encode.modeled_drift value).
         n_tiles = sum(j.n_tiles for j in group)
         attrs = {"occupancy": len(group), "tiles": n_tiles,
-                 "mode": group[0].mode}
+                 "mode": group[0].mode, "device_id": self._device_id}
         modeled = None
         # The modeled cost feeds both the span attrs and the /metrics
         # drift distribution — compute it whenever either consumer is
@@ -664,6 +673,8 @@ class EncodeScheduler:
         finally:
             if self._sink is not None:
                 self._sink.count("encode.device_launches")
+                self._sink.count(
+                    f"encode.device_launches.d{self._device_id}")
                 self._sink.count("encode.batched_tiles", n_tiles)
                 self._sink.observe("encode.batch_occupancy", len(group))
                 # Drift samples come from completed launches only: a

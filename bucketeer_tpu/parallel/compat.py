@@ -1,0 +1,25 @@
+"""Version-compat shims for the manual-partitioning API.
+
+``shard_map`` moved (jax.experimental.shard_map -> jax.shard_map) and
+renamed its replication-check kwarg (``check_rep`` -> ``check_vma``)
+across the jax versions this repo supports. Every shard_map
+construction site — the sharded DWT (parallel/sharded_dwt.py) and the
+graftmesh registry lowering (analysis/graftmesh.py) — imports the
+symbol and the no-check kwargs from here so the dance lives in exactly
+one place.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:                              # jax >= 0.8 exports it at top level
+    from jax import shard_map
+except ImportError:               # older jax
+    from jax.experimental.shard_map import shard_map
+
+# The replication-check kwarg was renamed check_rep -> check_vma.
+SM_NO_CHECK = ({"check_vma": False}
+               if "check_vma" in inspect.signature(shard_map).parameters
+               else {"check_rep": False})
+
+__all__ = ["shard_map", "SM_NO_CHECK"]

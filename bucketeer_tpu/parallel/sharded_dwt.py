@@ -21,28 +21,19 @@ subband row ordering — is shard-local with no resharding between levels.
 """
 from __future__ import annotations
 
-import inspect
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-try:                              # jax >= 0.8 exports it at top level
-    from jax import shard_map
-except ImportError:               # older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..analysis.contracts import contract
 from ..codec.dwt import (ALPHA, BETA, DELTA, GAMMA, K_HI, K_LO,
                          _fwd53_last, _fwd97_last)
+from .compat import SM_NO_CHECK, shard_map
 from .mesh import TILE_AXIS
-
-# The replication-check kwarg was renamed check_rep -> check_vma.
-_SM_NO_CHECK = ({"check_vma": False}
-                if "check_vma" in inspect.signature(shard_map).parameters
-                else {"check_rep": False})
 
 HALO = 4  # covers the 4-step 9/7 lifting support
 
@@ -121,6 +112,21 @@ def can_row_shard(h: int, levels: int, n_shards: int) -> bool:
     return per % (1 << levels) == 0 and (per >> levels) >= 3
 
 
+def sharded_dwt_program(levels: int, reversible: bool, mesh: Mesh,
+                        ndim: int = 2):
+    """(shard_map-wrapped fn, row PartitionSpec) for the multi-level
+    DWT at ``ndim`` input rank — the construction
+    :func:`sharded_dwt2d_forward` runs, shared with the graftmesh
+    registry (analysis/graftmesh.py), which lowers it under the forced
+    8-device host mesh and audits its halo-exchange collectives."""
+    row = tuple(None for _ in range(ndim - 2)) + (TILE_AXIS, None)
+    spec = P(*row)
+    fn = shard_map(partial(_local_dwt, levels, reversible, TILE_AXIS),
+                   mesh=mesh, in_specs=(spec,), out_specs=spec,
+                   **SM_NO_CHECK)
+    return fn, spec
+
+
 @contract(shapes={"x": [("H", "W"), ("C", "H", "W")]},
           dtypes={"x": "number"})
 def sharded_dwt2d_forward(x: jnp.ndarray, levels: int, reversible: bool,
@@ -132,11 +138,7 @@ def sharded_dwt2d_forward(x: jnp.ndarray, levels: int, reversible: bool,
     Returns (ll, bands) row-sharded identically to
     :func:`bucketeer_tpu.codec.dwt.dwt2d_forward`'s layout.
     """
-    row = tuple(None for _ in range(x.ndim - 2)) + (TILE_AXIS, None)
-    spec = P(*row)
-    fn = shard_map(partial(_local_dwt, levels, reversible, TILE_AXIS),
-                   mesh=mesh, in_specs=(spec,), out_specs=spec,
-                   **_SM_NO_CHECK)
+    fn, _ = sharded_dwt_program(levels, reversible, mesh, x.ndim)
     return fn(x)
 
 

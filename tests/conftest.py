@@ -81,6 +81,37 @@ def repo_facts(tmp_path_factory):
     return pickle.loads(out.read_bytes())
 
 
+@pytest.fixture(scope="session")
+def mesh_facts():
+    """One mesh-registry lowering per test session (test_graftmesh) —
+    forced into graftmesh's subprocess path even though this
+    interpreter already runs the 8-device mesh: the inline path clears
+    JAX's global caches first (fingerprint reproducibility), which
+    would force every later test's compiled programs to rebuild."""
+    from bucketeer_tpu.analysis import graftmesh
+
+    return graftmesh.run_mesh_programs(in_process=False)
+
+
+@pytest.fixture()
+def cached_mesh_lowering(mesh_facts, monkeypatch):
+    """Patch graftmesh.run_mesh_programs to replay the session's mesh
+    lowering — the graftmesh analog of cached_lowering below, for CLI
+    tests of --mesh-audit argument handling and gating."""
+    import copy
+
+    from bucketeer_tpu.analysis import graftmesh
+
+    def replay(entries=None, *, in_process=None):
+        if entries is not None:
+            raise ValueError("cached mesh lowering replays the "
+                             "registry only")
+        return [copy.deepcopy(f) for f in mesh_facts]
+
+    monkeypatch.setattr(graftmesh, "run_mesh_programs", replay)
+    return mesh_facts
+
+
 @pytest.fixture()
 def cached_lowering(repo_facts, monkeypatch):
     """Patch deviceaudit.run_programs to replay the session's lowering

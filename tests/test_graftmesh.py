@@ -1,0 +1,441 @@
+"""Static SPMD/collective audit (analysis/graftmesh.py): the mesh
+registry lowers and partitions under the forced 8-device host mesh,
+the collective parser prices exact bytes with the ring model, the
+shard-* rules fire on seeded violations exactly once, and the mesh
+manifest gate fails on doubled modeled ICI traffic while layout
+jitter under the tolerance passes.
+
+The expensive part — partitioning every registered mesh program —
+runs once per session (the mesh_facts subprocess fixture) and only in
+the tests marked ``slow``: tier-1 keeps the parsers, the drift-gate
+semantics (synthetic section) and the seeded violations, while the
+``shard-audit`` CI job runs this file unfiltered. Seeded violations
+lower tiny synthetic programs in-process, which works because
+conftest.py starts this interpreter under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+"""
+import json
+import shutil
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bucketeer_tpu.analysis import deviceaudit, graftmesh, rules_shard
+from bucketeer_tpu.analysis.__main__ import main as cli_main
+
+REPO = Path(__file__).resolve().parent.parent
+MANIFEST = REPO / ".graftaudit-manifest.json"
+BASELINE = REPO / ".graftlint-baseline.json"
+
+
+def _lowered(mesh_facts):
+    return [f for f in mesh_facts if not f.skipped]
+
+
+# --- the ring model and HLO parsers ------------------------------------
+
+def test_ring_model_bytes():
+    assert graftmesh.ring_ici_bytes("collective-permute", 100, 8) == 100
+    assert graftmesh.ring_ici_bytes("all-gather", 100, 8) == 700
+    assert graftmesh.ring_ici_bytes("all-reduce", 100, 8) == 175
+    assert graftmesh.ring_ici_bytes("reduce-scatter", 100, 8) == 87
+    assert graftmesh.ring_ici_bytes("all-to-all", 100, 8) == 87
+    # A group of one moves nothing (permute is point-to-point: it
+    # still pays its operand).
+    assert graftmesh.ring_ici_bytes("all-gather", 100, 1) == 0
+
+
+def test_parse_collectives_iota_literal_and_async_forms():
+    hlo = "\n".join([
+        # Iota replica_groups [num_groups, group_size].
+        "  %ag = f32[8,16]{1,0} all-gather(f32[1,16]{1,0} %p), "
+        "channel_id=1, replica_groups=[1,8]<=[8], dimensions={0}",
+        # Async pair with literal groups of two: the -start carries the
+        # operand, the -done must NOT double-count.
+        "  %ar-s = f32[4]{0} all-reduce-start(f32[4]{0} %x), "
+        "replica_groups={{0,1},{2,3}}, to_apply=%add",
+        "  %ar-d = f32[4]{0} all-reduce-done(f32[4]{0} %ar-s)",
+        # No replica_groups attribute: the full mesh.
+        "  %cp = s32[64]{0} collective-permute(s32[64]{0} %y), "
+        "source_target_pairs={{0,1},{1,2}}",
+    ])
+    got = graftmesh.parse_collectives(hlo, n_devices=8)
+    assert got["all-gather"] == {"count": 1, "bytes_in": 64,
+                                 "ici_bytes": 64 * 7}
+    assert got["all-reduce"] == {"count": 1, "bytes_in": 16,
+                                 "ici_bytes": 2 * 16 * 1 // 2}
+    assert got["collective-permute"] == {"count": 1, "bytes_in": 256,
+                                         "ici_bytes": 256}
+
+
+def test_parse_replicated_params_ignores_sharded_ones():
+    hlo = "\n".join([
+        "  %p0 = f32[8,64]{1,0} parameter(0), "
+        "sharding={devices=[8,1]<=[8]}",
+        "  %p1 = f32[1024]{0} parameter(1), sharding={replicated}",
+        "  %p2 = s32[] parameter(2), sharding={replicated}",
+    ])
+    assert graftmesh.parse_replicated_params(hlo) == ((1, 4096), (2, 4))
+
+
+# --- the registry on the real sharded programs -------------------------
+
+@pytest.mark.slow
+def test_registry_lowers_at_least_three_mesh_programs(mesh_facts):
+    lowered = _lowered(mesh_facts)
+    assert len(lowered) >= 3, [f.skipped for f in mesh_facts]
+    families = {f.name.split("/")[0] for f in lowered}
+    # Every sharded execution path the encoder ships is represented.
+    assert {"shard.dwt.tile", "shard.transform.data",
+            "shard.cxdmq.fused.data"} <= families
+
+
+@pytest.mark.slow
+def test_dwt_halo_exchange_is_the_only_collective(mesh_facts):
+    """The row-sharded DWT declares exactly its halo exchange: two
+    ppermutes per level x two levels, and nothing else."""
+    dwt = [f for f in _lowered(mesh_facts)
+           if f.name.startswith("shard.dwt.tile/")]
+    assert dwt
+    for f in dwt:
+        assert set(f.collectives) == {"collective-permute"}, f.name
+        assert f.collectives["collective-permute"]["count"] == 4, f.name
+        assert f.ici_bytes > 0
+
+
+@pytest.mark.slow
+def test_data_parallel_programs_are_collective_free(mesh_facts):
+    """Tiles/blocks on the data axis are independent — a clean
+    partition has zero collectives; anything else is the routing bug
+    this audit exists to catch."""
+    data = [f for f in _lowered(mesh_facts)
+            if f.name.split("/")[0].endswith(".data")]
+    assert data
+    for f in data:
+        assert f.collectives == {}, (f.name, f.collectives)
+        assert f.ici_bytes == 0
+
+
+@pytest.mark.slow
+def test_mesh_facts_are_fully_populated(mesh_facts):
+    for f in _lowered(mesh_facts):
+        assert f.peak_live_bytes > 0, f.name
+        assert len(f.fingerprint) == 64, f.name
+        n = 1
+        for size in f.mesh_shape.values():
+            n *= size
+        assert n == graftmesh.MESH_DEVICES, (f.name, f.mesh_shape)
+        assert f.axes_used, f.name
+        # The comms term reached the roofline input.
+        assert f.cost is not None and f.cost.ici_bytes == f.ici_bytes
+
+
+@pytest.mark.slow
+def test_repo_mesh_programs_are_rule_clean(mesh_facts):
+    findings = rules_shard.run(mesh_facts)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.slow
+def test_checked_in_manifest_matches_mesh_programs(mesh_facts):
+    drift = graftmesh.diff_mesh_manifest(
+        deviceaudit.load_manifest(MANIFEST),
+        graftmesh.mesh_manifest_from_facts(mesh_facts))
+    assert drift == [], ("sharded programs drifted; regenerate with "
+                         "`python -m bucketeer_tpu.analysis "
+                         "--mesh-audit --write-manifest` and commit "
+                         "the diff:\n" + "\n".join(drift))
+
+
+# --- seeded violations, lowered in-process -----------------------------
+
+def test_seeded_implicit_allgather_fires_exactly_once():
+    """A sharding-constraint mismatch — input sharded over data, body
+    pinned replicated — makes GSPMD reshard 8 MB over the
+    interconnect; shard-implicit-allgather must fire, once."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bucketeer_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    mesh = make_mesh(tile_parallel=1)
+
+    def build():
+        def forced(x):
+            y = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P()))
+            return y * 2
+        return (forced, (batch_sharding(mesh),),
+                [jax.ShapeDtypeStruct((8, 512, 512), jnp.float32)])
+
+    facts = graftmesh.lower_mesh_program(
+        graftmesh.MeshProgram("synthetic/allgather", build))
+    assert not facts.skipped, facts.skipped
+    cell = facts.collectives.get("all-gather")
+    assert cell and cell["ici_bytes"] >= rules_shard.ALLGATHER_MIN_BYTES
+    findings = rules_shard.run([facts])
+    assert [f.rule for f in findings] == [
+        rules_shard.SHARD_IMPLICIT_ALLGATHER]
+    assert "all-gather" in findings[0].message
+
+
+def test_seeded_replicated_large_operand_fires_exactly_once():
+    """A 100 MB operand left fully replicated costs every device the
+    global array; shard-replicated-large must fire, once — while the
+    registry's 4-byte replicated scalars stay under the threshold."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bucketeer_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    mesh = make_mesh(tile_parallel=1)
+
+    def build():
+        def apply(x, table):
+            return x + table[0]
+        ins = (batch_sharding(mesh), NamedSharding(mesh, P()))
+        return apply, ins, [
+            jax.ShapeDtypeStruct((8, 64), jnp.float32),
+            jax.ShapeDtypeStruct((25_000_000,), jnp.float32)]
+
+    facts = graftmesh.lower_mesh_program(
+        graftmesh.MeshProgram("synthetic/replicated", build))
+    assert not facts.skipped, facts.skipped
+    assert (1, 100_000_000) in facts.replicated_args
+    findings = rules_shard.run([facts])
+    assert [f.rule for f in findings] == [
+        rules_shard.SHARD_REPLICATED_LARGE]
+    assert "operand 1" in findings[0].message
+
+
+def test_seeded_dead_mesh_axis_fires_exactly_once():
+    """A 4x2 mesh whose program shards only over 'data' leaves the
+    2-device 'tile' axis idle; shard-axis-dead must fire, once."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bucketeer_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(tile_parallel=2)
+
+    def build():
+        return (lambda x: x * 2,
+                (NamedSharding(mesh, P("data")),),
+                [jax.ShapeDtypeStruct((8, 64), jnp.float32)])
+
+    facts = graftmesh.lower_mesh_program(
+        graftmesh.MeshProgram("synthetic/deadaxis", build))
+    assert not facts.skipped, facts.skipped
+    assert facts.mesh_shape == {"data": 4, "tile": 2}
+    assert facts.axes_used == ("data",)
+    findings = rules_shard.run([facts])
+    assert [f.rule for f in findings] == [rules_shard.SHARD_AXIS_DEAD]
+    assert "'tile'" in findings[0].message
+
+
+# --- the mesh manifest drift gate --------------------------------------
+# Pure-function tests on a hand-built mesh section shaped exactly like
+# mesh_manifest_from_facts output — no lowering, so tier-1 keeps the
+# gate semantics without paying the session fixture.
+
+def _synth_section():
+    return {
+        "shard.a.tile/T8": {
+            "fingerprint": "a" * 64,
+            "mesh": {"data": 1, "tile": 8},
+            "collectives": {"collective-permute": {
+                "count": 4, "bytes_in": 3072, "ici_bytes": 3072}},
+            "ici_bytes": 3072, "peak_live_bytes": 112696},
+        "shard.b.data/B8": {
+            "fingerprint": "b" * 64,
+            "mesh": {"data": 8, "tile": 1},
+            "collectives": {},
+            "ici_bytes": 0, "peak_live_bytes": 228352},
+    }
+
+
+def _synth_manifest():
+    return {"jax": jax.__version__,
+            graftmesh.MESH_MANIFEST_KEY: _synth_section()}
+
+
+def test_doubled_ici_traffic_fails_drift_gate():
+    """The acceptance scenario: a change that doubles a program's
+    modeled ICI traffic dies at the gate with one actionable line —
+    no hardware run needed."""
+    new = _synth_section()
+    new["shard.a.tile/T8"]["ici_bytes"] *= 2
+    drift = graftmesh.diff_mesh_manifest(_synth_manifest(), new)
+    assert len(drift) == 1 and "shard.a.tile/T8" in drift[0]
+    assert "ici_bytes" in drift[0] and "+100%" in drift[0]
+
+
+def test_cost_jitter_under_tolerance_passes_drift_gate():
+    new = _synth_section()
+    for entry in new.values():
+        entry["ici_bytes"] = int(entry["ici_bytes"] * 1.05)
+        entry["peak_live_bytes"] = int(entry["peak_live_bytes"] * 1.05)
+    assert graftmesh.diff_mesh_manifest(_synth_manifest(), new) == []
+
+
+def test_collective_histogram_change_is_drift():
+    new = _synth_section()
+    new["shard.a.tile/T8"]["collectives"]["collective-permute"][
+        "count"] += 2
+    drift = graftmesh.diff_mesh_manifest(_synth_manifest(), new)
+    assert len(drift) == 1 and "shard.a.tile/T8" in drift[0]
+    assert "collective histogram" in drift[0]
+    assert "collective-permute" in drift[0]
+
+
+def test_fingerprint_ghost_and_missing_section_drift():
+    old = _synth_manifest()
+    new = _synth_section()
+    new["shard.a.tile/T8"]["fingerprint"] = "0" * 64
+    drift = graftmesh.diff_mesh_manifest(old, new)
+    assert len(drift) == 1 and "fingerprint changed" in drift[0]
+
+    old[graftmesh.MESH_MANIFEST_KEY]["ghost/prog"] = {
+        "fingerprint": "x", "collectives": {}, "ici_bytes": 0,
+        "peak_live_bytes": 0}
+    drift = graftmesh.diff_mesh_manifest(old, new)
+    assert any("ghost/prog" in line for line in drift)
+    # A program this environment could not lower is tolerated missing.
+    assert not any("ghost/prog" in line for line in
+                   graftmesh.diff_mesh_manifest(
+                       old, new, skipped=("ghost/prog",)))
+
+    # No checked-in mesh section at all: one regenerate-and-commit line.
+    for missing in (None, {"jax": jax.__version__}):
+        lines = graftmesh.diff_mesh_manifest(missing, new)
+        assert len(lines) == 1 and "--mesh-audit" in lines[0]
+
+
+def test_jax_version_change_is_one_actionable_line():
+    old = _synth_manifest()
+    old["jax"] = "0.0.stale"
+    drift = graftmesh.diff_mesh_manifest(old, _synth_section())
+    assert len(drift) == 1
+    assert "0.0.stale" in drift[0] and jax.__version__ in drift[0]
+
+
+# --- CLI ----------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_mesh_audit_passes_on_repo(capsys, cached_mesh_lowering):
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--mesh-audit",
+                   "--strict", "--baseline", str(BASELINE),
+                   "--manifest", str(MANIFEST)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "shard.dwt.tile/" in out and "MB ICI/device" in out
+
+
+@pytest.mark.slow
+def test_cli_mesh_audit_fails_on_doubled_ici(tmp_path, capsys,
+                                             cached_mesh_lowering):
+    manifest = json.loads(MANIFEST.read_text(encoding="utf-8"))
+    assert any(e["ici_bytes"]
+               for e in manifest[graftmesh.MESH_MANIFEST_KEY].values())
+    for entry in manifest[graftmesh.MESH_MANIFEST_KEY].values():
+        entry["ici_bytes"] *= 2
+    bad = tmp_path / "manifest.json"
+    bad.write_text(json.dumps(manifest) + "\n", encoding="utf-8")
+    dump = tmp_path / "dump"
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--mesh-audit",
+                   "--baseline", str(BASELINE), "--manifest", str(bad),
+                   "--dump-dir", str(dump)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "shard-manifest-drift" in out and "ici_bytes" in out
+    # The partitioned HLO was dumped for the CI artifact upload.
+    assert list(dump.glob("*.partitioned.hlo.txt"))
+
+
+def test_cli_write_manifest_without_mesh_audit_preserves_section(
+        tmp_path, capsys, cached_lowering):
+    """A single-device --write-manifest refresh must carry the mesh
+    section over, not silently drop it (that would turn the next
+    --mesh-audit run red)."""
+    working = tmp_path / "manifest.json"
+    shutil.copy(MANIFEST, working)
+    before = json.loads(working.read_text(encoding="utf-8"))[
+        graftmesh.MESH_MANIFEST_KEY]
+    assert before, "expected a checked-in mesh section"
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--write-manifest",
+                   "--manifest", str(working)])
+    assert rc == 0, capsys.readouterr().out
+    after = json.loads(working.read_text(encoding="utf-8"))
+    assert after[graftmesh.MESH_MANIFEST_KEY] == before
+
+
+@pytest.mark.slow
+def test_stale_shard_baseline_entry_fails_strict(tmp_path, capsys,
+                                                 cached_mesh_lowering):
+    """A fixed shard offender leaves a stale baseline line: --mesh-audit
+    --strict must fail on it, while a lint-only run must leave shard
+    entries alone (the family did not run)."""
+    data = json.loads(BASELINE.read_text(encoding="utf-8"))
+    data["findings"].append({
+        "fingerprint": "feedfacefeedface",
+        "rule": "shard-axis-dead",
+        "path": "<graftmesh:ghost.mesh/T8>", "line": 0})
+    tampered = tmp_path / "baseline.json"
+    tampered.write_text(json.dumps(data) + "\n", encoding="utf-8")
+
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--mesh-audit",
+                   "--strict", "--baseline", str(tampered),
+                   "--manifest", str(MANIFEST)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "stale-baseline-entry" in out and "feedfacefeedface" in out
+
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--strict",
+                   "--baseline", str(tampered)])
+    assert rc == 0, capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_skipped_mesh_program_shard_entries_are_not_stale(
+        tmp_path, capsys, mesh_facts, monkeypatch):
+    """An environment that cannot partition a mesh program must not
+    judge that program's shard baseline entries stale — mirrors
+    diff_mesh_manifest's skipped= tolerance."""
+    import copy
+
+    hobbled = copy.deepcopy(mesh_facts)
+    hobbled[0].skipped = "synthetic: not lowerable here"
+    name = hobbled[0].name
+    monkeypatch.setattr(
+        graftmesh, "run_mesh_programs",
+        lambda entries=None, *, in_process=None: copy.deepcopy(hobbled))
+    data = json.loads(BASELINE.read_text(encoding="utf-8"))
+    data["findings"].append({
+        "fingerprint": "cafebabecafebabe",
+        "rule": "shard-implicit-allgather",
+        "path": f"<graftmesh:{name}>", "line": 0})
+    tampered = tmp_path / "baseline.json"
+    tampered.write_text(json.dumps(data) + "\n", encoding="utf-8")
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--mesh-audit",
+                   "--strict", "--baseline", str(tampered),
+                   "--manifest", str(MANIFEST)])
+    out = capsys.readouterr().out
+    assert "not lowerable here" in out
+    assert rc == 0, out
+
+
+def test_lint_only_write_baseline_preserves_shard_entries(tmp_path,
+                                                          capsys):
+    """A plain --write-baseline must not drop shard-* entries it did
+    not re-derive — same keep rule the perf family has."""
+    data = json.loads(BASELINE.read_text(encoding="utf-8"))
+    data["findings"].append({
+        "fingerprint": "0123456789abcdef",
+        "rule": "shard-replicated-large",
+        "path": "<graftmesh:ghost>", "line": 0})
+    working = tmp_path / "baseline.json"
+    working.write_text(json.dumps(data) + "\n", encoding="utf-8")
+    rc = cli_main([str(REPO / "bucketeer_tpu"), "--write-baseline",
+                   "--baseline", str(working)])
+    assert rc == 0, capsys.readouterr().out
+    after = json.loads(working.read_text(encoding="utf-8"))["findings"]
+    assert any(e["fingerprint"] == "0123456789abcdef" for e in after)

@@ -261,6 +261,7 @@ def test_merged_launch_span_links_both_requests():
             assert linked == {"req-0", "req-1"}, launch["links"]
             assert launch["attrs"]["tiles"] == 2
             assert launch["attrs"]["mode"] == "rows"
+            assert launch["attrs"]["device_id"] == 0
             # graftcost-modeled cost beside the measured duration —
             # the per-launch measured-vs-modeled drift sample.
             assert launch["attrs"]["modeled_s"] > 0

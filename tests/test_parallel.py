@@ -144,6 +144,40 @@ def test_mesh_encode_tiled_decodable(rng, mesh42):
     np.testing.assert_array_equal(_decode(data), img)
 
 
+def test_mesh_encode_spatial_bit_exact_vs_single_device(rng, mesh8):
+    """Tier-1 contract for the sharded_transform_tile path: the mesh
+    encode is not just decodable, it is byte-identical to the
+    single-device encoder — the lossless pipeline is pure integer
+    arithmetic, so any sharding seam that moves a bit shows up here."""
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+
+    img = rng.integers(0, 256, size=(128, 96), dtype=np.uint8)
+    params = EncodeParams(lossless=True, levels=2)
+    assert (encoder.encode_jp2(img, 8, params, mesh=mesh8)
+            == encoder.encode_jp2(img, 8, params))
+
+
+def test_mesh_encode_tiled_bit_exact_vs_single_device(rng, mesh42):
+    """Same contract for the run_tiles_sharded data-parallel path."""
+    from bucketeer_tpu.codec import encoder
+    from bucketeer_tpu.codec.encoder import EncodeParams
+
+    img = rng.integers(0, 256, size=(160, 160, 3), dtype=np.uint8)
+    params = EncodeParams(lossless=True, levels=2, tile_size=64)
+    assert (encoder.encode_jp2(img, 8, params, mesh=mesh42)
+            == encoder.encode_jp2(img, 8, params))
+
+
+def test_shard_map_compat_is_single_sourced():
+    """The version-compat shard_map import lives in parallel/compat.py
+    only — sharded_dwt (and analysis/graftmesh) consume it from there."""
+    from bucketeer_tpu.parallel import compat, sharded_dwt
+
+    assert sharded_dwt.shard_map is compat.shard_map
+    assert set(compat.SM_NO_CHECK) <= {"check_vma", "check_rep"}
+
+
 def test_converter_routes_through_mesh(rng, monkeypatch, tmp_path):
     """The converter path: an over-threshold image on a multi-device
     host encodes its tile batches through run_tiles_sharded and the

@@ -106,6 +106,10 @@ def test_merged_launch_occupancy_and_metrics(sched):
     assert occ["max"] > 1
     assert rep["stages"]["encode.queue_wait"]["count"] == 4
     assert rep["counters"]["encode.device_launches"] >= 1
+    # ROADMAP item 2 groundwork: launches are attributed to a device;
+    # a single-pool scheduler books everything against device 0.
+    assert (rep["counters"]["encode.device_launches.d0"]
+            == rep["counters"]["encode.device_launches"])
     assert rep["counters"]["encode.batched_tiles"] == 4
 
 
