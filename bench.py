@@ -685,8 +685,12 @@ def config7_concurrent_serving(repeats: int) -> dict:
     measured device-batch occupancy (requests per merged launch), the
     serialized 1-client x N*R baseline, and byte-identity vs the serial
     encoder — the continuous-batching numbers the serving story stands
-    on. Env: BENCH_CLIENTS, BENCH_REQS_PER_CLIENT, BENCH_SERVE_SIZE,
-    BENCH_SCHED_SLOTS, BENCH_SCHED_WINDOW_MS."""
+    on. With more than one visible device the scheduler's pool spreads
+    launches (ISSUE 17): the report carries per-device launch counts
+    and a serialized single-device-pool comparison round. Env:
+    BENCH_CLIENTS, BENCH_REQS_PER_CLIENT, BENCH_SERVE_SIZE,
+    BENCH_SCHED_SLOTS, BENCH_SCHED_WINDOW_MS, BENCH_SCHED_DEVICES
+    (0 = every visible device)."""
     import threading
 
     from bucketeer_tpu.codec import encoder
@@ -703,6 +707,7 @@ def config7_concurrent_serving(repeats: int) -> dict:
     # share; the queue (not the OS scheduler) should hold the excess.
     slots = _env_int("BENCH_SCHED_SLOTS",
                      max(2, min(n_clients, (os.cpu_count() or 2) - 1)))
+    devices = _env_int("BENCH_SCHED_DEVICES", 0)
     imgs = [[synthetic_photo(size, seed=300 + 16 * c + k)
              for k in range(per_client)] for c in range(n_clients)]
     flat = [im for client_imgs in imgs for im in client_imgs]
@@ -722,10 +727,12 @@ def config7_concurrent_serving(repeats: int) -> dict:
 
     sched = EncodeScheduler(max_concurrent=slots,
                             queue_depth=2 * n_clients,
-                            window_s=window_s)
+                            window_s=window_s,
+                            devices=devices or None)
     sink = Metrics()
 
-    def round_trip() -> tuple:
+    def round_trip(s=None) -> tuple:
+        s = s if s is not None else sched
         outs = [[None] * per_client for _ in range(n_clients)]
         lats: list = []
         errs: list = []
@@ -736,7 +743,7 @@ def config7_concurrent_serving(repeats: int) -> dict:
             for k in range(per_client):
                 c0 = time.perf_counter()
                 try:
-                    outs[c][k] = sched.encode_jp2(imgs[c][k], 8, params)
+                    outs[c][k] = s.encode_jp2(imgs[c][k], 8, params)
                 except BaseException as exc:
                     errs.append(exc)
                     return
@@ -767,6 +774,17 @@ def config7_concurrent_serving(repeats: int) -> dict:
         all_lats.extend(l)
         if best is None or wall < best:
             best, outs, lats = wall, o, l
+    # Single-device-pool comparison round (ISSUE 17): same clients and
+    # images with the pool pinned to one device — the floor the
+    # multi-device aggregate throughput must not fall below.
+    sched1 = EncodeScheduler(max_concurrent=slots,
+                             queue_depth=2 * n_clients,
+                             window_s=window_s, devices=1)
+    try:
+        round_trip(sched1)       # warm this pool's merge window shape
+        single_best = min(round_trip(sched1)[0] for _ in range(2))
+    finally:
+        sched1.close()
     try:
         lats_ms = sorted(x * 1e3 for x in lats)
         rep = sink.report()
@@ -798,6 +816,16 @@ def config7_concurrent_serving(repeats: int) -> dict:
             "speedup_vs_serialized": round(serial_s / best, 2),
             "occupancy": {"mean": occ["mean"], "max": occ["max"],
                           "launches": occ["count"]},
+            "devices": sched.pool_report().get("devices"),
+            "device_launches": {
+                k.rsplit(".", 1)[-1]: v for k, v in counters.items()
+                if k.startswith("encode.device_launches.d")},
+            "distinct_devices": sum(
+                1 for k in counters
+                if k.startswith("encode.device_launches.d")),
+            "single_device_pool_seconds": round(single_best, 3),
+            "speedup_vs_single_device_pool": round(single_best / best,
+                                                   2),
             "queue_wait_ms": round(
                 1e3 * qw.get("total_s", 0.0) / max(1, qw.get("count", 1)),
                 2),
@@ -964,6 +992,11 @@ def config8_tile_storm(repeats: int) -> dict:
             "index_misses": counters.get("decode.index_cache_misses", 0),
         },
         "admission_rejects": counters.get("decode.admission_rejects", 0),
+        # Least-loaded pool placement of decode request threads
+        # (ISSUE 17): which devices served the cold-phase reads.
+        "device_assigned": {
+            k.rsplit(".", 1)[-1]: v for k, v in counters.items()
+            if k.startswith("decode.device_assigned.d")},
         "server_p95_ms": round(server_p95_ms, 1),
         "stage_profile": _stage_profile(sink2, ("decode.",)),
         "stage_percentiles": _stage_percentiles(sink2, ("decode.",)),

@@ -146,6 +146,10 @@ class _PipelineServices:
     dispatch: object          # callable(plan, tiles, mode=...) -> pending
     pool: object              # shared executor; NOT shut down per encode
     check: object = None      # callable raising on deadline/cancel
+    t1_launch: object = None  # callable(stage_fn, payload) -> stage
+                              # result; the scheduler's pipeline-stage
+                              # hook for the fused CX/D+MQ program
+                              # (None = run inline on this thread)
 
 
 def current_services() -> _PipelineServices | None:
@@ -153,11 +157,12 @@ def current_services() -> _PipelineServices | None:
 
 
 @contextlib.contextmanager
-def pipeline_services(dispatch=None, pool=None, check=None):
+def pipeline_services(dispatch=None, pool=None, check=None,
+                      t1_launch=None):
     """Install scheduler-owned pipeline services for encodes running on
     this thread (the scheduler wraps each admitted request in this)."""
     prev = getattr(_SERVICES, "svc", None)
-    _SERVICES.svc = _PipelineServices(dispatch, pool, check)
+    _SERVICES.svc = _PipelineServices(dispatch, pool, check, t1_launch)
     try:
         yield
     finally:
@@ -947,11 +952,21 @@ def encode_array(img: np.ndarray, bitdepth: int = 8,
             # the MQ coder back to back (symbols stay in HBM between
             # the two programs) and ships finished byte segments; the
             # shared host Tier-1 pool is bypassed entirely.
-            with obs.span("encode.t1_device", blocks=len(chunk.dests)):
-                res = cxd_mod.run_device_mq(
-                    chunk.fres.blocks, chunk.fres.nbps, floors,
+            def t1_stage(blocks_dev):
+                return cxd_mod.run_device_mq(
+                    blocks_dev, chunk.fres.nbps, floors,
                     chunk.bandnames, chunk.hs, chunk.ws,
                     chunk.fres.layout.P, frac_bits)
+
+            with obs.span("encode.t1_device", blocks=len(chunk.dests)):
+                if svc is not None and svc.t1_launch is not None:
+                    # Pipeline-stage mapping: the scheduler stages the
+                    # fused program onto its Tier-1 device subset (the
+                    # payload is re-committed to the worker's core);
+                    # the span here covers staging wait + execution.
+                    res = svc.t1_launch(t1_stage, chunk.fres.blocks)
+                else:
+                    res = t1_stage(chunk.fres.blocks)
             _tm_add("device", res.cxd_s + res.mq_s)
             _tm_add("cxd", res.cxd_s)
             _tm_add("mq_dev", res.mq_s)

@@ -298,7 +298,8 @@ class PendingFrontend:
 @contract(shapes={"tiles": [("B", "h", "w"), ("B", "h", "w", "C")]},
           dtypes={"tiles": "number"})
 def dispatch_frontend(plan: TilePlan, tiles: np.ndarray,
-                      mode: str = "rows") -> PendingFrontend:
+                      mode: str = "rows",
+                      device=None) -> PendingFrontend:
     """Queue transform + blockify + stats for a (B, h, w[, C]) tile
     batch on the device and return without waiting for the result.
     ``mode="cxd"`` keeps the raw blockified coefficients on device for
@@ -307,7 +308,10 @@ def dispatch_frontend(plan: TilePlan, tiles: np.ndarray,
     cxd.run_device_mq) — the front-end program is identical to "cxd"
     (one compiled variant serves both; the modes diverge downstream),
     the distinct name exists so the scheduler and metrics can tell the
-    pipelines apart."""
+    pipelines apart. ``device`` (a ``jax.Device``) stages the batch
+    with a *committed* ``jax.device_put`` so the program — and every
+    downstream stage consuming its output, even from another thread —
+    runs on that core; None keeps default placement."""
     if tiles.ndim == 3:
         tiles = tiles[..., None]
     # Dtype audit at the host->device boundary: the device program's
@@ -328,8 +332,13 @@ def dispatch_frontend(plan: TilePlan, tiles: np.ndarray,
             [tiles, np.zeros((pad,) + tiles.shape[1:], tiles.dtype)])
     layout = layout_for(plan)
     prog_mode = "cxd" if mode == "mq" else mode
-    out, stats = _compiled_frontend(plan, layout.P, prog_mode)(
-        jnp.asarray(tiles))
+    # Committed placement (device_put) vs jnp.asarray matters: an
+    # uncommitted array snaps back to the default device the moment a
+    # different thread consumes it; a committed one pins the whole
+    # downstream chain (gather, fused Tier-1) to the pool worker's core.
+    staged = (jax.device_put(tiles, device) if device is not None
+              else jnp.asarray(tiles))
+    out, stats = _compiled_frontend(plan, layout.P, prog_mode)(staged)
     if prog_mode == "rows":
         return PendingFrontend(layout, b, out, stats)
     return PendingFrontend(layout, b, None, stats, blocks=out)

@@ -81,6 +81,14 @@ SCHED_MAX_CONCURRENT = "bucketeer.sched.max.concurrent"
 SCHED_POOL_SIZE = "bucketeer.sched.pool.size"
 SCHED_WINDOW_MS = "bucketeer.sched.window.ms"
 SCHED_DEADLINE_S = "bucketeer.sched.deadline.s"
+# Device-pool data plane: worker-per-device cap (0 = every
+# jax.devices() entry), pipeline-stage mapping mode (auto | off), and
+# a fixed front-end/Tier-1 split overriding the bi-criteria mapper
+# (0 = let the mapper choose). Env analogs: BUCKETEER_SCHED_DEVICES,
+# BUCKETEER_SCHED_PIPELINE, BUCKETEER_SCHED_PIPELINE_SPLIT.
+SCHED_DEVICES = "bucketeer.sched.devices"
+SCHED_PIPELINE = "bucketeer.sched.pipeline"
+SCHED_PIPELINE_SPLIT = "bucketeer.sched.pipeline.split"
 # Decoded-image LRU cache budget for the GET /images read path, in MB
 # (converters/reader.py; 0 disables). Env analog by the standard
 # overlay: BUCKETEER_DECODE_CACHE_MB.
@@ -123,7 +131,8 @@ ALL_KEYS = (
     MESH_MIN_PIXELS, CONVERSION_TYPE, DEVICE_CXD, DEVICE_MQ,
     COMPILE_CACHE,
     SCHED_QUEUE_DEPTH, SCHED_MAX_CONCURRENT, SCHED_POOL_SIZE,
-    SCHED_WINDOW_MS, SCHED_DEADLINE_S, DECODE_CACHE_MB,
+    SCHED_WINDOW_MS, SCHED_DEADLINE_S, SCHED_DEVICES, SCHED_PIPELINE,
+    SCHED_PIPELINE_SPLIT, DECODE_CACHE_MB,
     JOB_JOURNAL_DIR, RETRY_MAX_ATTEMPTS, RETRY_BASE_DELAY_S,
     RETRY_MAX_DELAY_S, BREAKER_THRESHOLD, BREAKER_RESET_S,
 )

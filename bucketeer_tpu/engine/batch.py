@@ -87,6 +87,23 @@ class BatchConverterWorker:
         if cache_dir:
             from ..converters.tpu import maybe_enable_compile_cache
             maybe_enable_compile_cache(cache_dir)
+        # Device-pool data plane (engine/scheduler.py): the worker
+        # applies the pool cap and pipeline-stage mapping to whichever
+        # scheduler its converter routes through — the converter's own
+        # instance when it carries one, else the process-wide one.
+        sched = getattr(converter, "scheduler", None)
+        if sched is None:
+            from .scheduler import get_scheduler
+            sched = get_scheduler()
+        sched.configure(
+            devices=config.get_int(cfg.SCHED_DEVICES, 0) or None,
+            pipeline=config.get_str(cfg.SCHED_PIPELINE) or None,
+            pipeline_split=config.get_int(cfg.SCHED_PIPELINE_SPLIT, 0)
+            or None)
+        if config.get_str(cfg.SCHED_PIPELINE):
+            LOG.info("scheduler pipeline mapping %s by config "
+                     "(devices=%d, split=%d)", sched.pipeline,
+                     sched.devices, sched.pipeline_split)
 
     def register(self, bus: MessageBus, instances: int = 2) -> None:
         bus.consumer(BATCH_CONVERTER, self.handle, instances=instances)

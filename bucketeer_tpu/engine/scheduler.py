@@ -1,6 +1,6 @@
-"""Cross-request scheduler: continuous device batching for encodes, a
-shared multi-threaded host Tier-1 pool, and typed admission control for
-encode *and* decode (region-read) jobs.
+"""Cross-request scheduler: a device-pool data plane with per-device
+continuous batching, a shared multi-threaded host Tier-1 pool, and typed
+admission control for encode, decode (region-read), and tensor jobs.
 
 Before this module every encode request ran a private pipeline:
 ``encode_array`` spun up its own one-worker executor for host Tier-1 and
@@ -10,22 +10,46 @@ their MQ replay on single host threads, and re-paid dispatch overhead
 per chunk. The scheduler is the process-wide service that owns device
 access and host Tier-1 capacity instead:
 
-- **Device batching** — concurrent encodes submit their chunks here
-  rather than dispatching directly. A single device thread owns all
-  front-end launches; compatible chunks from *different* requests (same
-  tile plan, mode, dtype) are concatenated into one launch, padded to
-  the existing power-of-two batch buckets (pipeline._bucket) so jitted
-  programs are reused, not retraced. Each request gets back a sliced
-  view of the merged result — per-tile results are bit-identical to a
-  solo launch because every front-end reduction is within-tile.
+- **Device pool** — one worker thread per ``jax.devices()`` entry
+  (capped by ``bucketeer.sched.devices`` / ``BUCKETEER_SCHED_DEVICES``),
+  all pulling from the one merged priority queue, so encode chunks,
+  merged tensor-codec chunks, and (in pipeline mode) fused CX/D+MQ
+  stages dispatch to whichever device is free. Workers spawn on demand:
+  a serial workload runs on device 0 exactly like the old single device
+  thread (no gratuitous per-device recompiles); backlog beyond the idle
+  workers brings the next device online. Launches stage their host
+  batch with ``jax.device_put(..., device)`` so the compiled program
+  runs on the worker's own core — committed inputs keep every
+  downstream device stage (gather, fused Tier-1) on that core instead
+  of thrashing back to device 0.
+- **Continuous batching** — compatible chunks from *different* requests
+  (same tile plan, mode, dtype) are concatenated into one launch,
+  padded to the existing power-of-two batch buckets (pipeline._bucket)
+  so jitted programs are reused, not retraced. Each request gets back a
+  sliced view of the merged result — per-tile results are bit-identical
+  to a solo launch because every front-end reduction is within-tile.
+  A worker only holds the aggregation window when no idle peer could
+  take arriving work instead: with free devices, parallelism beats
+  batching. Tensor-codec chunks (same dtype/row shape/backend) merge
+  the same way into one pack+MQ launch, sliced per request —
+  per-block coding is independent, so merged output is byte-identical.
   CX/D- and device-MQ-mode chunks (``BUCKETEER_DEVICE_CXD`` /
-  ``BUCKETEER_DEVICE_MQ``) are not merged — their blockified
+  ``BUCKETEER_DEVICE_MQ``) are never merged — their blockified
   coefficients stay HBM-resident for separate device stages whose
-  programs are shaped per chunk — but they still flow through the same
-  device thread. With device MQ active the host Tier-1 pool below is
-  bypassed outright: chunks come back from the device as finished
-  code-blocks (codec/cxd.run_device_mq) and the host's share is block
-  assembly on the request thread.
+  programs are shaped per chunk — but they still flow through the pool.
+- **Pipeline-stage mapping** (``bucketeer.sched.pipeline=auto|off``,
+  default off) — with device MQ active, the encode pipeline has two
+  device stages: the DWT/quant front-end and the fused CX/D+MQ
+  program. In ``auto`` mode the pool is split into two disjoint device
+  subsets (front-end gets workers ``[0, k)``, Tier-1 gets ``[k, n)``)
+  joined by the same bounded queue acting as the inter-stage staging
+  buffer (depth ``BUCKETEER_SCHED_STAGE_DEPTH``, default
+  ``2*(n-k)``). The split ``k`` comes from the bi-criteria
+  throughput-vs-latency heuristic of PAPERS.md (arxiv 0801.1772):
+  minimize the pipeline period ``max(cA/k, cB/(n-k))`` first, latency
+  ``cA/k + cB/(n-k)`` second, using graftcost's modeled per-stage
+  costs (obs/cost.modeled_stage_costs); ``bucketeer.sched.pipeline.
+  split`` overrides the mapper.
 - **Shared host Tier-1** — MQ replay / packed Tier-1 runs on one pool
   sized to host cores (``t1_encode_cxd``/``t1_encode_packed`` release
   the GIL, proven in tests/test_native_t1.py), with per-request ordered
@@ -38,22 +62,28 @@ access and host Tier-1 capacity instead:
   items, and each request can carry a deadline that expires both while
   queued and at chunk-dispatch boundaries.
 - **Typed jobs** — requests carry a ``kind`` (``"encode"`` |
-  ``"decode"``). Both kinds share the one bounded queue and slot pool
-  (one device, one host — the resources are shared, so the admission
-  bound must be too), but decode jobs skip the encode pipeline seam and
+  ``"decode"`` | ``"tensor"``). All kinds share the one bounded queue
+  and slot pool (the resources are shared, so the admission bound must
+  be too); decode jobs skip the encode pipeline seam, run on a
+  least-loaded assigned device (``jax.default_device``), and
   interactive tile reads (:data:`PRIORITY_READ`) outrank every encode,
   so a deep-zoom viewer's 512² window is never starved behind a batch
   ingest. :meth:`read` is the decode-typed entry.
 
 Observability (``set_metrics_sink``): ``encode.queue_wait`` /
-``decode.queue_wait`` (stages), ``encode.batch_occupancy`` (value
-distribution: requests per device launch), and counters
-``{encode,decode}.admission_rejects``, ``encode.device_launches``
-(plus the per-device ``encode.device_launches.d<N>`` — one entry
-today; the ROADMAP item 2 device pool inherits the split for free),
-``encode.batched_tiles``, ``{encode,decode}.deadline_expired``.
-Merged-launch spans carry a ``device_id`` attribute for the same
-reason.
+``decode.queue_wait`` (stages), ``encode.batch_occupancy`` /
+``tensor.batch_occupancy`` (value distributions: requests per device
+launch), counters ``{encode,decode,tensor}.admission_rejects``,
+``{encode,tensor,t1}.device_launches`` plus the per-device
+``....device_launches.d<N>`` split (real worker device ids — the PR 16
+placeholder that booked everything on d0 is gone),
+``{decode,tensor}.device_assigned.d<N>`` for request-thread placement,
+``encode.batched_tiles``, ``tensor.batched_blocks``,
+``{encode,decode}.deadline_expired``. Merged-launch spans carry the
+worker's ``device_id``. A ``sched`` reporter on the sink adds the
+per-device occupancy gauge (``sched.device_occupancy.d<N>``: busy
+fraction since the pool started) and the live device-queue depth to
+``/metrics`` reports.
 
 The pipeline-mapping trade-off this implements — shared replicated
 workers per stage versus per-request pipelines, throughput vs latency —
@@ -63,14 +93,15 @@ stacks use.
 """
 from __future__ import annotations
 
+import functools
 import heapq
 import itertools
 import logging
 import os
 import threading
 import time
-from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -93,6 +124,9 @@ PRIORITY_TENSOR = 1      # tensor-codec jobs: batch-class, never ahead
 # staging (rows buffers) bounded however many requests pile up.
 _MAX_BATCH_TILES = int(os.environ.get("BUCKETEER_SCHED_MAX_BATCH_TILES",
                                       "64"))
+# Same bound for merged tensor-codec chunks, in code-blocks.
+_MAX_BATCH_BLOCKS = int(os.environ.get(
+    "BUCKETEER_SCHED_MAX_BATCH_BLOCKS", "128"))
 
 
 class QueueFull(RuntimeError):
@@ -141,26 +175,88 @@ class _Ticket:
 class _DeviceJob:
     """One chunk's front-end launch request. ``ctx`` is the submitting
     request's graftscope span context, captured on the request thread
-    (the device thread has none): the merged launch span *links* every
+    (the worker thread has none): the merged launch span *links* every
     request whose chunks it batched through these."""
     plan: object
     tiles: np.ndarray
     mode: str
     n_tiles: int
     ctx: object = None
+    priority: int = PRIORITY_SINGLE
+    seq: int = 0
     event: threading.Event = field(
         default_factory=lambda: seam.make_event("DeviceJob.event"))
     result: object = None
     error: BaseException | None = None
+
+    stage = "frontend"
 
     @property
     def key(self):
         # Merge-compatibility: identical jitted program + concatenable
         # host batch. "rows" only — cxd/mq launches are shaped per
         # chunk (their downstream device stages bucket on realized
-        # symbol counts).
+        # symbol counts); mode is part of the key so they never match.
         return (self.plan, self.mode, self.tiles.dtype.str,
                 self.tiles.shape[1:])
+
+    @property
+    def size(self) -> int:
+        return self.n_tiles
+
+
+@dataclass
+class _TensorJob:
+    """One tensor-codec chunk's device launch request (pack + device
+    MQ over ``n_blocks`` code-blocks). Merge-compatible jobs are
+    concatenated like encode rows chunks; per-block coding is
+    independent, so each request's slice is byte-identical to a solo
+    launch."""
+    rows: np.ndarray
+    floors: np.ndarray
+    backend: str
+    n_blocks: int
+    ctx: object = None
+    priority: int = PRIORITY_TENSOR
+    seq: int = 0
+    event: threading.Event = field(
+        default_factory=lambda: seam.make_event("TensorJob.event"))
+    result: object = None
+    error: BaseException | None = None
+
+    stage = "tensor"
+
+    @property
+    def key(self):
+        return ("tensor", self.backend, self.rows.dtype.str,
+                self.rows.shape[1:])
+
+    @property
+    def size(self) -> int:
+        return self.n_blocks
+
+
+@dataclass
+class _T1Job:
+    """One staged fused-CX/D+MQ launch (pipeline mode): ``fn`` is the
+    encoder's closed-over stage function, ``payload`` the HBM-resident
+    blockified coefficients, re-committed to the Tier-1 worker's device
+    before the call."""
+    fn: object
+    payload: object = None
+    ctx: object = None
+    priority: int = PRIORITY_SINGLE
+    seq: int = 0
+    event: threading.Event = field(
+        default_factory=lambda: seam.make_event("T1Job.event"))
+    result: object = None
+    error: BaseException | None = None
+
+    stage = "t1"
+
+    @property
+    def size(self) -> int:
+        return 1
 
 
 @dataclass
@@ -193,7 +289,7 @@ def _env_float(name: str, default: float) -> float:
 
 class EncodeScheduler:
     """Process-wide encode service: admission -> slot -> pipelined
-    encode with scheduler-owned device dispatch and host pool.
+    encode with scheduler-owned device-pool dispatch and host pool.
 
     Defaults (env-overridable):
 
@@ -202,9 +298,18 @@ class EncodeScheduler:
     - ``BUCKETEER_SCHED_MAX_CONCURRENT`` (8): encode slots; beyond
       this, admitted requests wait (by priority, then FIFO).
     - ``BUCKETEER_SCHED_POOL`` (host cores): shared Tier-1 workers.
-    - ``BUCKETEER_SCHED_WINDOW_MS`` (3): aggregation window the device
-      thread waits for co-batchable chunks while other requests are in
-      flight. 0 disables merging.
+    - ``BUCKETEER_SCHED_WINDOW_MS`` (3): aggregation window a device
+      worker waits for co-batchable chunks while other requests are in
+      flight and no idle peer device could take them. 0 disables
+      merging.
+    - ``BUCKETEER_SCHED_DEVICES`` (0 = all): device-pool size cap; the
+      pool has one worker per ``jax.devices()`` entry up to the cap.
+    - ``BUCKETEER_SCHED_PIPELINE`` (off): ``auto`` maps the front-end
+      and fused-Tier-1 stages onto disjoint device subsets.
+    - ``BUCKETEER_SCHED_PIPELINE_SPLIT`` (0 = mapper): fixed front-end
+      subset size, overriding the bi-criteria mapper.
+    - ``BUCKETEER_SCHED_STAGE_DEPTH`` (0 = ``2*(n-split)``): bound on
+      staged (queued) Tier-1 launches in pipeline mode.
     - ``BUCKETEER_SCHED_DEADLINE_S`` (0 = none): default per-request
       deadline.
     - ``BUCKETEER_SCHED_RETRY_AFTER_S`` (2): the Retry-After hint
@@ -216,7 +321,11 @@ class EncodeScheduler:
                  pool_size: int | None = None,
                  window_s: float | None = None,
                  deadline_s: float | None = None,
-                 retry_after_s: float | None = None) -> None:
+                 retry_after_s: float | None = None,
+                 devices: int | None = None,
+                 pipeline: str | None = None,
+                 pipeline_split: int | None = None,
+                 stage_depth: int | None = None) -> None:
         cores = os.cpu_count() or 2
         self.queue_depth = queue_depth if queue_depth is not None else \
             _env_int("BUCKETEER_SCHED_QUEUE_DEPTH", 32)
@@ -236,14 +345,21 @@ class EncodeScheduler:
                 "BUCKETEER_SCHED_DEADLINE_S", 0.0) or None
         self.retry_after_s = retry_after_s if retry_after_s is not None \
             else _env_float("BUCKETEER_SCHED_RETRY_AFTER_S", 2.0)
+        self.devices = devices if devices is not None else \
+            _env_int("BUCKETEER_SCHED_DEVICES", 0)
+        self.pipeline = pipeline if pipeline is not None else \
+            (os.environ.get("BUCKETEER_SCHED_PIPELINE") or "off")
+        if self.pipeline not in ("auto", "off"):
+            raise ValueError(
+                "bucketeer.sched.pipeline must be 'auto' or 'off', "
+                f"got {self.pipeline!r}")
+        self.pipeline_split = pipeline_split if pipeline_split is not \
+            None else _env_int("BUCKETEER_SCHED_PIPELINE_SPLIT", 0)
+        self.stage_depth = stage_depth if stage_depth is not None else \
+            _env_int("BUCKETEER_SCHED_STAGE_DEPTH", 0)
 
         self._pool = ThreadPoolExecutor(max_workers=max(1, self.pool_size),
                                         thread_name_prefix="sched-t1")
-        # ROADMAP item 2 groundwork: one device loop today, so every
-        # merged launch lands on device 0 — but spans and counters
-        # already carry the id, so the pool refactor inherits
-        # per-device observability instead of retrofitting it.
-        self._device_id = 0
         self._lock = seam.make_lock("EncodeScheduler._lock")
         self._seq = itertools.count()
         self._waiting: list = []      # heap of (priority, seq, ticket)
@@ -252,24 +368,61 @@ class EncodeScheduler:
         self._closed = False          # admission-side close flag
         self._sink = None
 
+        # -- device pool state (guarded by _dq_cv) --------------------
         self._dq_cv = seam.make_condition("EncodeScheduler._dq_cv")
-        self._djobs: deque = deque()
-        self._device_thread = None    # threading.Thread-like handle
+        self._djobs: list = []        # the one merged priority queue
+        self._dseq = itertools.count()
+        self._devices: list | None = None   # resolved lazily
+        self._workers: list = []      # per-device thread (or None)
+        self._busy_s: list = []       # accumulated busy seconds
+        self._busy_since: list = []   # launch start, None when idle
+        self._inflight: list = []     # request-thread device assignments
+        self._pool_t0: float | None = None
+        self._split: int | None = None      # engaged pipeline split
         self._stop = False            # device-side close flag
         # Test/graftrace seam: overrides codec.frontend.dispatch_frontend
-        # so scenarios can explore the batching skeleton without JAX.
+        # so scenarios can explore the batching skeleton without JAX
+        # (the pool simulates `devices or 1` deviceless workers then).
         self.launch_fn = None
 
     # -- metrics ------------------------------------------------------
 
     def set_metrics_sink(self, sink) -> None:
         """Install a server.metrics.Metrics-like sink (``record``,
-        ``observe``, ``count``); None disables."""
+        ``observe``, ``count``); None disables. Sinks with
+        ``add_reporter`` also get the ``sched`` pool report (per-device
+        occupancy gauge + queue depth) attached."""
         self._sink = sink
+        if sink is not None and hasattr(sink, "add_reporter"):
+            sink.add_reporter("sched", self.pool_report)
 
     def _count(self, name: str, n: int = 1) -> None:
         if self._sink is not None:
             self._sink.count(name, n)
+
+    def pool_report(self) -> dict:
+        """Live device-pool snapshot for /metrics: per-device occupancy
+        (busy fraction since the pool came up) and queue depth. Safe as
+        a Metrics reporter: report() calls reporters outside its own
+        lock, so taking ``_dq_cv`` here cannot invert."""
+        with self._dq_cv:
+            now = seam.monotonic()
+            out = {
+                "devices": (len(self._devices)
+                            if self._devices is not None else 0),
+                "device_queue_depth": len(self._djobs),
+                "pipeline": self.pipeline,
+                "pipeline_split": self._split,
+            }
+            if self._devices is not None and self._pool_t0 is not None:
+                elapsed = max(now - self._pool_t0, 1e-9)
+                for i in range(len(self._devices)):
+                    busy = self._busy_s[i]
+                    if self._busy_since[i] is not None:
+                        busy += now - self._busy_since[i]
+                    out[f"sched.device_occupancy.d{i}"] = round(
+                        min(busy / elapsed, 1.0), 4)
+            return out
 
     # -- configuration -------------------------------------------------
 
@@ -277,10 +430,19 @@ class EncodeScheduler:
                   max_concurrent: int | None = None,
                   pool_size: int | None = None,
                   window_s: float | None = None,
-                  deadline_s: float | None = None) -> None:
+                  deadline_s: float | None = None,
+                  devices: int | None = None,
+                  pipeline: str | None = None,
+                  pipeline_split: int | None = None) -> None:
         """Apply deployment config (engine/core.py wires the
         ``bucketeer.sched.*`` keys through here). Resizing the pool
-        swaps executors; in-flight jobs finish on the old one."""
+        swaps executors; in-flight jobs finish on the old one. The
+        device cap applies to pools not yet spun up — a live pool keeps
+        its resolved devices."""
+        if pipeline is not None and pipeline not in ("auto", "off"):
+            raise ValueError(
+                "bucketeer.sched.pipeline must be 'auto' or 'off', "
+                f"got {pipeline!r}")
         with self._lock:
             if queue_depth is not None and queue_depth > 0:
                 self.queue_depth = queue_depth
@@ -291,6 +453,12 @@ class EncodeScheduler:
                 self.window_s = window_s
             if deadline_s is not None:
                 self.default_deadline_s = deadline_s or None
+            if devices is not None and devices >= 0:
+                self.devices = devices
+            if pipeline is not None:
+                self.pipeline = pipeline
+            if pipeline_split is not None and pipeline_split >= 0:
+                self.pipeline_split = pipeline_split
             if pool_size is not None and pool_size > 0 and \
                     pool_size != self.pool_size:
                 old = self._pool
@@ -392,9 +560,9 @@ class EncodeScheduler:
         ``kind="encode"`` jobs run with the encoder's device dispatch
         and host Tier-1 routed through this scheduler;
         ``kind="decode"`` jobs (region/tile reads) share the same
-        bounded queue and slots and poll the deadline between Tier-1
-        code-blocks (t1_dec.decode_services) instead of the encode
-        pipeline seam.
+        bounded queue and slots, run on a least-loaded assigned pool
+        device, and poll the deadline between Tier-1 code-blocks
+        (t1_dec.decode_services) instead of the encode pipeline seam.
         Raises :class:`QueueFull` without blocking when the bounded
         queue is at depth, and :class:`SchedulerClosed` once
         :meth:`close` has run (including for requests that were queued
@@ -423,15 +591,27 @@ class EncodeScheduler:
                 self._await_slot(ticket)
             if kind == "tensor":
                 from ..tensor import tensor_services
-                with tensor_services(check=check):
-                    return fn(*args, **kwargs)
+                with tensor_services(
+                        check=check,
+                        launch=functools.partial(
+                            self.dispatch_tensor_chunk,
+                            _priority=ticket.priority)):
+                    with self._device_ctx(kind):
+                        return fn(*args, **kwargs)
             if kind != "encode":
                 from ..codec.decode import t1_dec
                 with t1_dec.decode_services(check=check):
-                    return fn(*args, **kwargs)
+                    with self._device_ctx(kind):
+                        return fn(*args, **kwargs)
+            t1_launch = None
+            if self.pipeline != "off":
+                t1_launch = functools.partial(
+                    self.dispatch_t1, _priority=ticket.priority)
             with encoder_mod.pipeline_services(
-                    dispatch=self.dispatch_frontend, pool=self._pool,
-                    check=check):
+                    dispatch=functools.partial(
+                        self.dispatch_frontend,
+                        _priority=ticket.priority),
+                    pool=self._pool, check=check, t1_launch=t1_launch):
                 return fn(*args, **kwargs)
         finally:
             self._finish(ticket)
@@ -459,7 +639,10 @@ class EncodeScheduler:
         past the bounded queue the caller gets :class:`QueueFull` ->
         503 + Retry-After like every other kind. The codec's
         ``tensor_services`` deadline hook is installed for the job's
-        duration (polled between chunks/blocks)."""
+        duration (polled between chunks/blocks), and device-backend
+        chunks route through :meth:`dispatch_tensor_chunk` so
+        compatible chunks from concurrent tensor jobs merge into one
+        pool launch."""
         return self.submit(fn, *args, priority=priority,
                            deadline_s=deadline_s, kind="tensor",
                            **kwargs)
@@ -483,23 +666,231 @@ class EncodeScheduler:
                            params, jpx=jpx, mesh=mesh, priority=priority,
                            deadline_s=deadline_s)
 
-    # -- device batching -----------------------------------------------
+    # -- device pool ---------------------------------------------------
 
-    def dispatch_frontend(self, plan, tiles, mode: str = "rows"):
-        """The encoder's device-dispatch hook: queue a front-end launch
-        and block until the device thread has dispatched it (the
-        launch itself stays async — JAX returns before the program
-        finishes). Compatible queued chunks are merged into one
-        launch; the caller gets its slice. Raises
-        :class:`SchedulerClosed` (never hangs) once :meth:`close` has
-        run."""
-        self._ensure_device_thread()
-        job = _DeviceJob(plan, np.asarray(tiles), mode, len(tiles),
-                         ctx=obs.current_context())
+    def _resolve_devices_locked(self) -> list:
+        """The pool's device list: ``jax.devices()`` capped by the
+        ``devices`` config, or ``devices or 1`` simulated (None)
+        entries when a test/graftrace ``launch_fn`` is installed —
+        scenarios explore the pool skeleton without importing JAX."""
+        cap = max(0, self.devices)
+        if self.launch_fn is not None:
+            return [None] * max(1, cap)
+        try:
+            import jax
+            devs = list(jax.devices())
+        except Exception:
+            # No usable JAX backend (e.g. analysis-only installs):
+            # fall back to one deviceless worker — launches then use
+            # default placement, exactly the pre-pool behavior.
+            return [None]
+        if cap > 0:
+            devs = devs[:cap]
+        return devs or [None]
+
+    def _ensure_devices_locked(self) -> None:
+        if self._devices is not None:
+            return
+        seam.write(self, "_devices")
+        self._devices = self._resolve_devices_locked()
+        n = len(self._devices)
+        seam.write(self, "_workers")
+        self._workers = [None] * n
+        seam.write(self, "_busy_s")
+        self._busy_s = [0.0] * n
+        seam.write(self, "_busy_since")
+        self._busy_since = [None] * n
+        seam.write(self, "_inflight")
+        self._inflight = [0] * n
+        # True from pop to launch completion: a worker inside its
+        # aggregation window owns a job without being "busy" yet, and
+        # must not read as idle to scale-up / idle-peer heuristics.
+        seam.write(self, "_holding")
+        self._holding = [False] * n
+        self._pool_t0 = seam.monotonic()
+
+    def _spawn_worker_locked(self, widx: int) -> None:
+        seam.write(self, "_workers")
+        self._workers[widx] = seam.start_thread(
+            self._worker_loop, name=f"sched-device-{widx}",
+            args=(widx,))
+
+    def _ensure_workers(self) -> None:
+        """Bring the pool up lazily: resolve the device list on first
+        use and guarantee at least worker 0 is alive. Further workers
+        spawn on demand (:meth:`_scale_up_locked`) — a serial workload
+        stays on device 0 and never pays per-device recompiles.
+        close() is permanent: a dispatch racing it gets the typed
+        error, never a resurrected half-alive pool."""
         with self._dq_cv:
             seam.read(self, "_stop")
             if self._stop:
                 raise SchedulerClosed("scheduler is closed")
+            self._ensure_devices_locked()
+            seam.read(self, "_workers")
+            if not any(t is not None and t.is_alive()
+                       for t in self._workers):
+                self._spawn_worker_locked(0)
+
+    def _scale_up_locked(self) -> None:
+        """Called after queueing a job: if the backlog exceeds the idle
+        live workers, bring the next device's worker online (also the
+        restart path for a fatally-dead worker slot — no job is ever
+        stranded on a dead worker)."""
+        idle = 0
+        seam.read(self, "_holding")
+        for i, t in enumerate(self._workers):
+            if t is not None and t.is_alive() \
+                    and self._busy_since[i] is None \
+                    and not self._holding[i]:
+                idle += 1
+        if idle >= len(self._djobs):
+            return
+        for i, t in enumerate(self._workers):
+            if t is None or not t.is_alive():
+                self._spawn_worker_locked(i)
+                return
+
+    def device_threads_alive(self) -> bool:
+        """True while any pool worker thread is alive (tests and the
+        graftrace shutdown scenarios assert close() really stopped the
+        pool)."""
+        with self._dq_cv:
+            seam.read(self, "_workers")
+            return any(t is not None and t.is_alive()
+                       for t in self._workers)
+
+    def _assign_device(self, kind: str):
+        """Least-loaded request-thread device assignment for decode /
+        tensor jobs (their compute runs on the request thread, not a
+        pool worker). Serial traffic always lands on device 0 —
+        identical placement to the pre-pool scheduler — and only
+        concurrent requests spread. Returns ``(device, index)`` or
+        ``(None, -1)`` when there is nothing to choose."""
+        if self.launch_fn is not None:
+            return None, -1
+        with self._dq_cv:
+            seam.read(self, "_stop")
+            if self._stop:
+                return None, -1
+            self._ensure_devices_locked()
+            devs = self._devices
+            if len(devs) < 2 or devs[0] is None:
+                return None, -1
+            best = min(range(len(devs)),
+                       key=lambda i: (self._inflight[i], i))
+            seam.write(self, "_inflight")
+            self._inflight[best] += 1
+        self._count(f"{kind}.device_assigned.d{best}")
+        return devs[best], best
+
+    @contextmanager
+    def _device_ctx(self, kind: str):
+        """Pin a decode/tensor request thread to its assigned device
+        for the duration (``jax.default_device``), releasing the
+        load-balance slot on exit."""
+        dev, idx = self._assign_device(kind)
+        if dev is None:
+            yield
+            return
+        import jax
+        try:
+            with jax.default_device(dev):
+                yield
+        finally:
+            with self._dq_cv:
+                seam.write(self, "_inflight")
+                self._inflight[idx] -= 1
+
+    # -- device batching -----------------------------------------------
+
+    def dispatch_frontend(self, plan, tiles, mode: str = "rows", *,
+                          _priority: int = PRIORITY_SINGLE):
+        """The encoder's device-dispatch hook: queue a front-end launch
+        and block until a pool worker has dispatched it (the launch
+        itself stays async — JAX returns before the program finishes).
+        Compatible queued chunks are merged into one launch; the
+        caller gets its slice. Raises :class:`SchedulerClosed` (never
+        hangs) once :meth:`close` has run."""
+        self._ensure_workers()
+        job = _DeviceJob(plan, np.asarray(tiles), mode, len(tiles),
+                         ctx=obs.current_context(), priority=_priority)
+        with self._dq_cv:
+            seam.read(self, "_stop")
+            if self._stop:
+                raise SchedulerClosed("scheduler is closed")
+            job.seq = next(self._dseq)
+            seam.write(self, "_djobs")
+            self._djobs.append(job)
+            self._scale_up_locked()
+            self._dq_cv.notify_all()
+        job.event.wait()
+        seam.read(job, "error")
+        if job.error is not None:
+            raise job.error
+        seam.read(job, "result")
+        return job.result
+
+    def dispatch_tensor_chunk(self, rows, floors,
+                              backend: str = "device", *,
+                              _priority: int = PRIORITY_TENSOR):
+        """The tensor codec's device-chunk hook (tensor_services
+        ``launch``): queue one chunk's pack+MQ launch on the pool and
+        block for its slice of the (possibly merged) result —
+        ``(blocks, n_syms, device_seconds)`` shaped exactly like
+        tensor.codec.encode_chunk_device."""
+        self._ensure_workers()
+        job = _TensorJob(np.asarray(rows), np.asarray(floors), backend,
+                         len(rows), ctx=obs.current_context(),
+                         priority=_priority)
+        with self._dq_cv:
+            seam.read(self, "_stop")
+            if self._stop:
+                raise SchedulerClosed("scheduler is closed")
+            job.seq = next(self._dseq)
+            seam.write(self, "_djobs")
+            self._djobs.append(job)
+            self._scale_up_locked()
+            self._dq_cv.notify_all()
+        job.event.wait()
+        seam.read(job, "error")
+        if job.error is not None:
+            raise job.error
+        seam.read(job, "result")
+        return job.result
+
+    def dispatch_t1(self, fn, payload=None, *,
+                    _priority: int = PRIORITY_SINGLE):
+        """Pipeline-stage hook: run ``fn(payload)`` (the fused CX/D+MQ
+        stage) on a Tier-1-subset pool worker when the pipeline split
+        is engaged, inline on the caller otherwise. The staging queue
+        is bounded (``stage_depth``) so a fast front-end cannot pile
+        unbounded HBM-resident coefficients behind a slow Tier-1
+        subset."""
+        self._ensure_workers()
+        with self._dq_cv:
+            n = len(self._devices)
+            engaged = (self.pipeline != "off" and n >= 2
+                       and not self._stop)
+            if engaged:
+                self._engage_split_locked()
+                depth = self.stage_depth or max(2, 2 * (n - self._split))
+        if not engaged:
+            return fn(payload)
+        job = _T1Job(fn, payload, ctx=obs.current_context(),
+                     priority=_priority)
+        with self._dq_cv:
+            while True:
+                seam.read(self, "_stop")
+                if self._stop:
+                    raise SchedulerClosed(
+                        "scheduler closed while staging a Tier-1 chunk")
+                staged = sum(1 for j in self._djobs
+                             if j.stage == "t1")
+                if staged < depth:
+                    break
+                self._dq_cv.wait(0.05)
+            job.seq = next(self._dseq)
             seam.write(self, "_djobs")
             self._djobs.append(job)
             self._dq_cv.notify_all()
@@ -510,38 +901,107 @@ class EncodeScheduler:
         seam.read(job, "result")
         return job.result
 
-    def _ensure_device_thread(self) -> None:
-        with self._dq_cv:
-            seam.read(self, "_stop")
-            if self._stop:
-                # close() is permanent. The old code reset _stop and
-                # restarted the thread here, so a submit racing close()
-                # resurrected a half-alive scheduler (found by the
-                # graftrace shutdown_drain scenario).
-                raise SchedulerClosed("scheduler is closed")
-            seam.read(self, "_device_thread")
-            if self._device_thread is None or \
-                    not self._device_thread.is_alive():
-                seam.write(self, "_device_thread")
-                self._device_thread = seam.start_thread(
-                    self._device_loop, name="sched-device")
+    def _engage_split_locked(self) -> None:
+        """First staged Tier-1 launch engages the pipeline split: pick
+        k (config override or the bi-criteria mapper), give the
+        front-end workers [0, k) and Tier-1 workers [k, n), and bring
+        the whole pool online — pipeline mode is explicit opt-in, so
+        eager spawn is the point."""
+        if self._split is not None:
+            return
+        n = len(self._devices)
+        seam.write(self, "_split")
+        self._split = self._plan_split(n)
+        LOG.info("pipeline split engaged: %d front-end / %d tier-1 "
+                 "workers over %d devices", self._split,
+                 n - self._split, n)
+        for i, t in enumerate(self._workers):
+            if t is None or not t.is_alive():
+                self._spawn_worker_locked(i)
+        self._dq_cv.notify_all()
+
+    def _plan_split(self, n: int) -> int:
+        """The bi-criteria mapper (PAPERS.md, arxiv 0801.1772): over
+        k in [1, n-1], minimize the pipeline period
+        ``max(cA/k, cB/(n-k))`` first and the latency
+        ``cA/k + cB/(n-k)`` second, with graftcost's modeled per-stage
+        seconds as cA (front-end) and cB (fused CX/D+MQ). Config
+        ``pipeline_split`` overrides; an even split is the no-model
+        fallback."""
+        if 1 <= self.pipeline_split <= n - 1:
+            return self.pipeline_split
+        costs = obs_cost.modeled_stage_costs()
+        if not costs:
+            return max(1, n // 2)
+        ca, cb = costs
+        best = None
+        for k in range(1, n):
+            cand = (max(ca / k, cb / (n - k)),
+                    ca / k + cb / (n - k), k)
+            if best is None or cand < best:
+                best = cand
+        return best[2]
+
+    def _stages_locked(self, widx: int) -> tuple:
+        """Which job stages worker ``widx`` may pull. No split: every
+        worker takes everything (a free device is a free device). Split
+        engaged: front-end workers [0, split) never touch staged Tier-1
+        work and vice versa — disjoint subsets are what makes the
+        mapping a pipeline. Merged tensor chunks ride either subset."""
+        if self._split is None:
+            return ("frontend", "tensor", "t1")
+        if widx < self._split:
+            return ("frontend", "tensor")
+        return ("t1", "tensor")
+
+    def _pop_job_locked(self, widx: int):
+        """Pop the highest-priority (then FIFO) queued job this worker
+        is allowed to run; None when nothing is eligible."""
+        stages = self._stages_locked(widx)
+        best = -1
+        for i, j in enumerate(self._djobs):
+            if j.stage not in stages:
+                continue
+            if best < 0 or (j.priority, j.seq) < \
+                    (self._djobs[best].priority, self._djobs[best].seq):
+                best = i
+        if best < 0:
+            return None
+        seam.write(self, "_djobs")
+        return self._djobs.pop(best)
+
+    def _idle_peer_locked(self, widx: int, stage: str) -> bool:
+        """True when another live, idle worker could run ``stage`` jobs:
+        holding the aggregation window then is futile (the peer would
+        pop arrivals immediately) and harmful (a free device should
+        parallelize, not wait to merge)."""
+        seam.read(self, "_holding")
+        for i, t in enumerate(self._workers):
+            if i == widx or t is None or not t.is_alive():
+                continue
+            if self._busy_since[i] is None and \
+                    not self._holding[i] and \
+                    stage in self._stages_locked(i):
+                return True
+        return False
 
     def _take_compatible_locked(self, group: list) -> int:
         """Move queued jobs merge-compatible with group[0] into the
         group (the _locked suffix is the codebase convention for
         "caller holds the lock" — here the queue cv; the lock-discipline
         lint, analysis/rules_locks.py, keys on it). Returns the group
-        tile total."""
-        key = group[0].key
-        total = sum(j.n_tiles for j in group)
-        kept: deque = deque()
-        while self._djobs:
-            seam.write(self, "_djobs")
-            j = self._djobs.popleft()
-            if j.mode == "rows" and j.key == key and \
-                    total + j.n_tiles <= _MAX_BATCH_TILES:
+        size total (tiles for frontend groups, blocks for tensor)."""
+        lead = group[0]
+        cap = (_MAX_BATCH_TILES if lead.stage == "frontend"
+               else _MAX_BATCH_BLOCKS)
+        key = lead.key
+        total = sum(j.size for j in group)
+        kept: list = []
+        for j in self._djobs:
+            if j.stage == lead.stage and j.key == key and \
+                    total + j.size <= cap:
                 group.append(j)
-                total += j.n_tiles
+                total += j.size
             else:
                 kept.append(j)
         seam.write(self, "_djobs")
@@ -549,44 +1009,62 @@ class EncodeScheduler:
         return total
 
     def _running_count(self) -> int:
-        """Granted-slot snapshot for the device thread's merge
-        heuristics. graftrace flagged the old bare ``self._running``
-        read here as a data race (every write happens under ``_lock``;
-        the device loop read it under ``_dq_cv`` only), so the snapshot
-        takes the lock — _dq_cv -> _lock nests nowhere in the reverse
-        order (the lock-order-cycle rule keeps it that way)."""
+        """Granted-slot snapshot for the workers' merge heuristics.
+        graftrace flagged the old bare ``self._running`` read here as a
+        data race (every write happens under ``_lock``; the device loop
+        read it under ``_dq_cv`` only), so the snapshot takes the lock
+        — _dq_cv -> _lock nests nowhere in the reverse order (the
+        lock-order-cycle rule keeps it that way)."""
         with self._lock:
             seam.read(self, "_running")
             return self._running
 
-    def _device_loop(self) -> None:
+    def _drain_queued_locked(self) -> None:
+        """Fail every still-queued device job typed at shutdown — every
+        per-device queue view drains, no waiter hangs."""
+        for j in self._djobs:
+            seam.write(j, "error")
+            j.error = SchedulerClosed(
+                "scheduler closed before this chunk's device launch")
+            j.event.set()
+        seam.write(self, "_djobs")
+        self._djobs = []
+
+    def _worker_loop(self, widx: int) -> None:
         while True:
             with self._dq_cv:
-                while not self._djobs and not self._stop:
+                while True:
+                    seam.read(self, "_stop")
+                    if self._stop:
+                        self._drain_queued_locked()
+                        return
+                    job = self._pop_job_locked(widx)
+                    if job is not None:
+                        break
                     self._dq_cv.wait()
-                seam.read(self, "_stop")
-                if self._stop:
-                    for j in self._djobs:
-                        seam.write(j, "error")
-                        j.error = SchedulerClosed(
-                            "scheduler closed before this chunk's "
-                            "device launch")
-                        j.event.set()
-                    seam.write(self, "_djobs")
-                    self._djobs.clear()
-                    return
-                seam.write(self, "_djobs")
-                group = [self._djobs.popleft()]
-                if group[0].mode == "rows" and self.window_s > 0:
+                seam.write(self, "_holding")
+                self._holding[widx] = True
+                # A pop frees staging-queue room: wake bounded
+                # dispatch_t1 stagers (and idle peers re-check).
+                self._dq_cv.notify_all()
+                group = [job]
+                mergeable = (job.stage == "tensor"
+                             or (job.stage == "frontend"
+                                 and job.mode == "rows"))
+                if mergeable and self.window_s > 0 and \
+                        not self._idle_peer_locked(widx, job.stage):
                     # Continuous batching: wait up to the window for
                     # co-batchable chunks while other running requests
-                    # could still contribute one.
+                    # could still contribute one — but only while no
+                    # idle peer device could take them instead.
+                    cap = (_MAX_BATCH_TILES if job.stage == "frontend"
+                           else _MAX_BATCH_BLOCKS)
                     limit = seam.monotonic() + self.window_s
                     while True:
                         total = self._take_compatible_locked(group)
                         running = self._running_count()
                         if (len(group) >= max(1, running)
-                                or total >= _MAX_BATCH_TILES):
+                                or total >= cap):
                             break
                         # Futile-wait cut: if every other running
                         # request already has an incompatible job
@@ -601,27 +1079,63 @@ class EncodeScheduler:
                         if remaining <= 0:
                             break
                         self._dq_cv.wait(remaining)
-                elif group[0].mode == "rows":
-                    # No window: merge only what is already queued.
+                        seam.read(self, "_stop")
+                        if self._stop:
+                            break
+                elif mergeable:
+                    # No window (or an idle peer): merge only what is
+                    # already queued.
                     self._take_compatible_locked(group)
+                seam.write(self, "_busy_since")
+                self._busy_since[widx] = seam.monotonic()
+            fatal = False
             try:
-                self._launch(group)
-            except Exception:
-                # _launch delivers per-job errors; anything escaping is
-                # a scheduler bug — log it and keep the loop alive so
-                # one bad group cannot wedge every later request.
-                LOG.exception("device loop error on a %d-job group",
-                              len(group))
+                if job.stage == "frontend":
+                    self._launch(group, widx)
+                elif job.stage == "tensor":
+                    self._launch_tensor(group, widx)
+                else:
+                    self._launch_t1(job, widx)
+            # The _launch* methods deliver per-job errors; anything
+            # escaping is a scheduler bug (or a fatal interrupt) — log
+            # it, fail the group's waiters so none hangs, and keep the
+            # pool serving.
+            except BaseException as exc:
+                fatal = not isinstance(exc, Exception)
+                LOG.exception("device worker %d error on a %d-job "
+                              "group", widx, len(group))
                 for j in group:
                     if not j.event.is_set():
+                        seam.write(j, "error")
                         j.error = RuntimeError("device launch failed")
                         j.event.set()
+            finally:
+                with self._dq_cv:
+                    seam.write(self, "_busy_s")
+                    self._busy_s[widx] += \
+                        seam.monotonic() - self._busy_since[widx]
+                    seam.write(self, "_busy_since")
+                    self._busy_since[widx] = None
+                    seam.write(self, "_holding")
+                    self._holding[widx] = False
+                    if fatal and not self._stop:
+                        # A fatally-interrupted worker replaces itself
+                        # before exiting so queued jobs are never
+                        # stranded on a dead slot.
+                        self._spawn_worker_locked(widx)
+            if fatal:
+                return
 
-    def _launch(self, group: list) -> None:
+    def _launch(self, group: list, widx: int) -> None:
+        dev = self._devices[widx]
         launch = self.launch_fn
         if launch is None:
             from ..codec import frontend
-            launch = frontend.dispatch_frontend
+            if dev is not None:
+                launch = functools.partial(frontend.dispatch_frontend,
+                                           device=dev)
+            else:
+                launch = frontend.dispatch_frontend
 
         # The merged launch belongs to no single request: it gets an
         # unparented span *linked* to every request span whose chunks
@@ -630,7 +1144,7 @@ class EncodeScheduler:
         # (the drift also lands as an encode.modeled_drift value).
         n_tiles = sum(j.n_tiles for j in group)
         attrs = {"occupancy": len(group), "tiles": n_tiles,
-                 "mode": group[0].mode, "device_id": self._device_id}
+                 "mode": group[0].mode, "device_id": widx}
         modeled = None
         # The modeled cost feeds both the span attrs and the /metrics
         # drift distribution — compute it whenever either consumer is
@@ -643,6 +1157,7 @@ class EncodeScheduler:
                 attrs["modeled_from"] = modeled[1]
         links = [j.ctx for j in group if j.ctx is not None]
         failed = False
+        completed = False
         t0 = seam.monotonic()
         try:
             with obs.span("device.launch", ctx=None, links=links,
@@ -662,6 +1177,7 @@ class EncodeScheduler:
                         j.result = _SlicedPending(merged, off,
                                                   j.n_tiles)
                         off += j.n_tiles
+            completed = True
         # The whole group shares the failed launch; the error is
         # delivered to every waiting request and re-raised there, so no
         # waiter hangs and nothing is swallowed.
@@ -673,8 +1189,7 @@ class EncodeScheduler:
         finally:
             if self._sink is not None:
                 self._sink.count("encode.device_launches")
-                self._sink.count(
-                    f"encode.device_launches.d{self._device_id}")
+                self._sink.count(f"encode.device_launches.d{widx}")
                 self._sink.count("encode.batched_tiles", n_tiles)
                 self._sink.observe("encode.batch_occupancy", len(group))
                 # Drift samples come from completed launches only: a
@@ -686,15 +1201,112 @@ class EncodeScheduler:
                         "encode.modeled_drift",
                         (seam.monotonic() - t0) / modeled[0])
             for j in group:
+                # A fatally-interrupted launch (BaseException in
+                # flight) reached neither the result assignments nor
+                # the except clause: the waiter must see a typed error,
+                # never a silent None result.
+                if not completed and j.error is None:
+                    seam.write(j, "error")
+                    j.error = RuntimeError("device launch failed")
                 j.event.set()
+
+    def _launch_tensor(self, group: list, widx: int) -> None:
+        """One merged tensor-codec pack+MQ launch. Per-block coding is
+        independent (codec/cxd.run_device_mq buckets each block by its
+        own realized length), so each job's block slice is byte-
+        identical to a solo launch; the aggregate symbol count and
+        device seconds are attributed proportionally by block count —
+        they feed stats/metrics, never output bytes."""
+        dev = self._devices[widx]
+        n_blocks = sum(j.n_blocks for j in group)
+        attrs = {"occupancy": len(group), "blocks": n_blocks,
+                 "mode": "tensor", "device_id": widx}
+        links = [j.ctx for j in group if j.ctx is not None]
+        completed = False
+        try:
+            with obs.span("device.launch", ctx=None, links=links,
+                          **attrs):
+                if len(group) == 1:
+                    rows = group[0].rows
+                    floors = group[0].floors
+                else:
+                    rows = np.concatenate([j.rows for j in group])
+                    floors = np.concatenate(
+                        [j.floors for j in group])
+                if self.launch_fn is not None:
+                    res = self.launch_fn(None, rows, mode="tensor")
+                    off = 0
+                    for j in group:
+                        seam.write(j, "result")
+                        j.result = (res, off, j.n_blocks)
+                        off += j.n_blocks
+                else:
+                    from ..tensor import codec as tensor_codec
+                    blocks, syms, dev_s = \
+                        tensor_codec.encode_chunk_device(
+                            rows, floors, group[0].backend, device=dev)
+                    off = 0
+                    for j in group:
+                        share = j.n_blocks / max(1, n_blocks)
+                        seam.write(j, "result")
+                        j.result = (blocks[off:off + j.n_blocks],
+                                    int(round(syms * share)),
+                                    dev_s * share)
+                        off += j.n_blocks
+            completed = True
+        except Exception as exc:    # graftlint: disable=swallowed-exception
+            for j in group:
+                seam.write(j, "error")
+                j.error = exc
+        finally:
+            if self._sink is not None:
+                self._sink.count("tensor.device_launches")
+                self._sink.count(f"tensor.device_launches.d{widx}")
+                self._sink.count("tensor.batched_blocks", n_blocks)
+                self._sink.observe("tensor.batch_occupancy", len(group))
+            for j in group:
+                if not completed and j.error is None:
+                    seam.write(j, "error")
+                    j.error = RuntimeError("device launch failed")
+                j.event.set()
+
+    def _launch_t1(self, job: _T1Job, widx: int) -> None:
+        """One staged fused-CX/D+MQ launch on a Tier-1-subset worker:
+        re-commit the payload to this worker's device (committed inputs
+        pin the compiled program there) and run the stage closure."""
+        dev = self._devices[widx]
+        attrs = {"occupancy": 1, "mode": "t1", "device_id": widx}
+        links = [job.ctx] if job.ctx is not None else []
+        completed = False
+        try:
+            with obs.span("device.launch", ctx=None, links=links,
+                          **attrs):
+                payload = job.payload
+                if dev is not None and payload is not None:
+                    import jax
+                    payload = jax.device_put(payload, dev)
+                seam.write(job, "result")
+                job.result = job.fn(payload)
+            completed = True
+        except Exception as exc:    # graftlint: disable=swallowed-exception
+            seam.write(job, "error")
+            job.error = exc
+        finally:
+            if self._sink is not None:
+                self._sink.count("t1.device_launches")
+                self._sink.count(f"t1.device_launches.d{widx}")
+            if not completed and job.error is None:
+                seam.write(job, "error")
+                job.error = RuntimeError("device launch failed")
+            job.event.set()
 
     # -- lifecycle -----------------------------------------------------
 
     def close(self) -> None:
         """Shut down, permanently: stop admission, cancel queued slot
-        waiters *typed* (:class:`SchedulerClosed`), let the in-flight
-        device group finish, drain still-queued device jobs typed,
-        then stop the device thread and the host pool.
+        waiters *typed* (:class:`SchedulerClosed`), let in-flight
+        device groups finish, drain still-queued device jobs typed,
+        then stop every pool worker and the host pool.
 
         The cancellation pass exists because graftrace's
         shutdown_drain scenario deadlocked the old close(): a request
@@ -714,10 +1326,16 @@ class EncodeScheduler:
             seam.write(self, "_stop")
             self._stop = True
             self._dq_cv.notify_all()
-            seam.read(self, "_device_thread")
-            device_thread = self._device_thread
-        if device_thread is not None:
-            device_thread.join(timeout=5)
+            seam.read(self, "_workers")
+            workers = list(self._workers)
+        for t in workers:
+            if t is not None:
+                t.join(timeout=5)
+        # Workers drain the queue on their way out; this final pass
+        # covers jobs queued against a pool whose workers had already
+        # died (nothing left to drain them) — every waiter fails typed.
+        with self._dq_cv:
+            self._drain_queued_locked()
         with self._lock:
             seam.read(self, "_admitted")
             busy = self._admitted > 0
@@ -734,13 +1352,23 @@ class EncodeScheduler:
         with self._lock:
             seam.read(self, "_running")
             seam.read(self, "_admitted")
-            return {"running": self._running,
-                    "waiting": len(self._waiting),
-                    "admitted": self._admitted,
-                    "queue_depth": self.queue_depth,
-                    "max_concurrent": self.max_concurrent,
-                    "pool_size": self.pool_size,
-                    "closed": self._closed}
+            out = {"running": self._running,
+                   "waiting": len(self._waiting),
+                   "admitted": self._admitted,
+                   "queue_depth": self.queue_depth,
+                   "max_concurrent": self.max_concurrent,
+                   "pool_size": self.pool_size,
+                   "closed": self._closed}
+        # Pool stats live under the queue cv; _lock -> _dq_cv must not
+        # nest (the lock-order-cycle rule), so this is a second scope.
+        with self._dq_cv:
+            out["devices"] = (len(self._devices)
+                              if self._devices is not None
+                              else self.devices)
+            out["device_queue_depth"] = len(self._djobs)
+            out["pipeline"] = self.pipeline
+            out["pipeline_split"] = self._split
+        return out
 
 
 # The class predates decode routing; the neutral name is the current

@@ -88,11 +88,48 @@ def modeled_launch_seconds(n_tiles: int) -> tuple | None:
     padded = _pow2_at_least(n_tiles)
     key, bucket, cost = min(
         entries, key=lambda e: (abs(e[1] - padded), e[0]))
-    t = (max(cost.get("flops", 0) / machine.peak_flops,
-             cost.get("hbm_bytes", 0) / machine.hbm_bytes_per_s)
-         + cost.get("scan_depth", 0) * machine.seq_step_s)
-    scaled = t * (padded / bucket)
+    scaled = _roofline(cost, machine) * (padded / bucket)
     return scaled, f"{key}@{machine.name}"
+
+
+def _roofline(cost: dict, machine) -> float:
+    return (max(cost.get("flops", 0) / machine.peak_flops,
+                cost.get("hbm_bytes", 0) / machine.hbm_bytes_per_s)
+            + cost.get("scan_depth", 0) * machine.seq_step_s)
+
+
+def modeled_stage_costs() -> tuple | None:
+    """(front_end_seconds, fused_t1_seconds) for the scheduler's
+    bi-criteria pipeline mapper, or None when the manifest or machine
+    model is unavailable. The front-end stage is the cxd-mode program
+    (``frontend.cxd/...``) and the Tier-1 stage the fused CX/D+MQ
+    program (``cxdmq.fused/...``, non-pallas — the portable variant the
+    CPU mesh actually runs); both are rooflined through the same
+    machine model as :func:`modeled_launch_seconds`. Absolute scale
+    cancels in the mapper's ratios, so canonical-variant costs are
+    exactly enough."""
+    manifest = (Path(__file__).resolve().parents[2]
+                / ".graftaudit-manifest.json")
+    try:
+        data = json.loads(manifest.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError):
+        return None
+    machine = _machine()
+    if machine is None:
+        return None
+    front = t1 = None
+    for key, rec in data.get("programs", {}).items():
+        cost = rec.get("cost")
+        if not cost:
+            continue
+        if key.startswith("frontend.cxd/") and front is None:
+            front = _roofline(cost, machine)
+        elif key.startswith("cxdmq.fused/") and \
+                not key.startswith("cxdmq.fused.pallas/") and t1 is None:
+            t1 = _roofline(cost, machine)
+    if front is None or t1 is None or front <= 0 or t1 <= 0:
+        return None
+    return front, t1
 
 
 def reset_cache() -> None:

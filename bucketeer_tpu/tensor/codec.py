@@ -82,17 +82,23 @@ _services = threading.local()
 
 
 @contextlib.contextmanager
-def tensor_services(check=None):
-    """Per-thread deadline hook polled between chunks/blocks — the
-    tensor-codec mirror of the encoder's ``pipeline_services`` and the
-    decoder's ``decode_services``. The scheduler installs it for
+def tensor_services(check=None, launch=None):
+    """Per-thread scheduler services — the tensor-codec mirror of the
+    encoder's ``pipeline_services`` and the decoder's
+    ``decode_services``. ``check`` is the deadline hook polled between
+    chunks/blocks; ``launch`` (``callable(rows, floors, backend) ->
+    (blocks, n_syms, device_seconds)``) routes device-backend chunks
+    through the scheduler's pool so compatible chunks from concurrent
+    tensor jobs merge into one launch. The scheduler installs both for
     ``kind="tensor"`` jobs."""
-    prev = getattr(_services, "check", None)
+    prev = (getattr(_services, "check", None),
+            getattr(_services, "launch", None))
     _services.check = check
+    _services.launch = launch
     try:
         yield
     finally:
-        _services.check = prev
+        _services.check, _services.launch = prev
 
 
 def _poll() -> None:
@@ -191,10 +197,14 @@ def _encode_host(rows: np.ndarray, floors: np.ndarray) -> list:
     return out
 
 
-def _encode_chunk_device(rows: np.ndarray, floors: np.ndarray,
-                         backend: str):
+def encode_chunk_device(rows: np.ndarray, floors: np.ndarray,
+                        backend: str, device=None):
     """One chunk through the device: pack -> CX/D (-> MQ). Returns
-    ([t1.CodedBlock], symbols, device_seconds)."""
+    ([t1.CodedBlock], symbols, device_seconds). ``device`` (a
+    ``jax.Device``) stages the limb buffer with a *committed*
+    ``jax.device_put`` so the pack and every downstream device stage
+    run on that core — the scheduler's pool workers use it; None keeps
+    default placement."""
     import jax.numpy as jnp
 
     n = len(rows)
@@ -203,7 +213,12 @@ def _encode_chunk_device(rows: np.ndarray, floors: np.ndarray,
     flat[:n * BLOCK_SAMPLES] = rows.ravel()
     graftcost.record_bucket("tensor.blocks", n, nbuck)
     t0 = time.perf_counter()
-    blocks_dev, maxmag_dev = _compiled_pack()(jnp.asarray(flat))
+    if device is not None:
+        import jax
+        staged = jax.device_put(flat, device)
+    else:
+        staged = jnp.asarray(flat)
+    blocks_dev, maxmag_dev = _compiled_pack()(staged)
     maxmag = fetch_block_meta(maxmag_dev)[:n]
     nbps = np.zeros(n, dtype=np.int32)
     nz = maxmag > 0
@@ -263,6 +278,7 @@ def encode_tensor(arr, planes: int | None = None,
     n_syms = 0
     dev_s = 0.0
     chunk = _chunk_blocks(chunk_blocks)
+    launch = getattr(_services, "launch", None)
     for off in range(0, len(rows), chunk):
         _poll()
         sub = rows[off:off + chunk]
@@ -270,7 +286,13 @@ def encode_tensor(arr, planes: int | None = None,
         if backend == "host":
             coded += _encode_host(sub, fsub)
         else:
-            blks, syms, ds = _encode_chunk_device(sub, fsub, backend)
+            if backend == "device" and launch is not None:
+                # Scheduler seam: the pool runs (and possibly merges)
+                # the chunk on a free device; byte-identical because
+                # per-block coding is independent of its batch-mates.
+                blks, syms, ds = launch(sub, fsub, backend)
+            else:
+                blks, syms, ds = encode_chunk_device(sub, fsub, backend)
             coded += blks
             n_syms += syms
             dev_s += ds

@@ -20,6 +20,16 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Default the scheduler's device pool to ONE worker for the suite:
+# on the forced 8-device mesh every device a worker scales onto pays
+# its own XLA recompile of the frontend program (~tens of seconds on
+# this CPU probe), which any test doing concurrent encodes would
+# otherwise trigger incidentally. Pool behavior is exercised
+# deliberately — with explicit ``devices=`` counts — by
+# tests/test_scheduler_pool.py; everything else keeps the seed's
+# single-device placement and runtime.
+os.environ.setdefault("BUCKETEER_SCHED_DEVICES", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

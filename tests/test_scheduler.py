@@ -91,26 +91,44 @@ def test_tiled_multichunk_through_scheduler(sched, monkeypatch):
     assert sched.encode_jp2(img, 8, params) == serial
 
 
-def test_merged_launch_occupancy_and_metrics(sched):
+def test_merged_launch_occupancy_and_metrics():
+    # devices=1 pins a single-worker pool: with free peer devices the
+    # scheduler prefers parallelism over merging, and this test is
+    # about the merge path (tests/test_scheduler_pool.py covers the
+    # multi-device spread).
+    sched = EncodeScheduler(queue_depth=16, max_concurrent=4,
+                            pool_size=2, window_s=0.2, devices=1)
     sink = Metrics()
     sched.set_metrics_sink(sink)
-    imgs = _images(4, 64, seed=14)
-    params = EncodeParams(lossless=True, levels=3)
-    serial = [encoder.encode_jp2(im, 8, params) for im in imgs]
-    outs, errs = _concurrent(sched, imgs, params)
-    assert errs == [None] * 4 and outs == serial
-    rep = sink.report()
-    occ = rep["values"]["encode.batch_occupancy"]
-    # 4 same-shape single-chunk requests inside a 200 ms window: at
-    # least one launch must have carried more than one request.
-    assert occ["max"] > 1
-    assert rep["stages"]["encode.queue_wait"]["count"] == 4
-    assert rep["counters"]["encode.device_launches"] >= 1
-    # ROADMAP item 2 groundwork: launches are attributed to a device;
-    # a single-pool scheduler books everything against device 0.
-    assert (rep["counters"]["encode.device_launches.d0"]
-            == rep["counters"]["encode.device_launches"])
-    assert rep["counters"]["encode.batched_tiles"] == 4
+    try:
+        imgs = _images(4, 64, seed=14)
+        params = EncodeParams(lossless=True, levels=3)
+        serial = [encoder.encode_jp2(im, 8, params) for im in imgs]
+        outs, errs = _concurrent(sched, imgs, params)
+        assert errs == [None] * 4 and outs == serial
+        rep = sink.report()
+        occ = rep["values"]["encode.batch_occupancy"]
+        # 4 same-shape single-chunk requests inside a 200 ms window: at
+        # least one launch must have carried more than one request.
+        assert occ["max"] > 1
+        assert rep["stages"]["encode.queue_wait"]["count"] == 4
+        assert rep["counters"]["encode.device_launches"] >= 1
+        # Launches are attributed to their real pool device: a
+        # one-device pool books everything against device 0, and the
+        # per-device split always sums to the total.
+        assert (rep["counters"]["encode.device_launches.d0"]
+                == rep["counters"]["encode.device_launches"])
+        per_dev = sum(v for k, v in rep["counters"].items()
+                      if k.startswith("encode.device_launches.d"))
+        assert per_dev == rep["counters"]["encode.device_launches"]
+        assert rep["counters"]["encode.batched_tiles"] == 4
+        # The pool reporter is attached to the sink: occupancy gauge +
+        # live queue depth appear in the same /metrics report.
+        assert rep["sched"]["devices"] == 1
+        assert "sched.device_occupancy.d0" in rep["sched"]
+        assert rep["sched"]["device_queue_depth"] == 0
+    finally:
+        sched.close()
 
 
 # --- failure isolation ------------------------------------------------
@@ -167,7 +185,7 @@ def test_failed_device_launch_propagates_to_all_requests(sched):
         with pytest.raises(ValueError):
             svc.dispatch(object(), np.zeros((1, 8, 8, 3), np.uint8))
 
-    def fake_dispatch(plan, tiles, mode="rows"):
+    def fake_dispatch(plan, tiles, mode="rows", device=None):
         raise ValueError("bad launch")
 
     import bucketeer_tpu.codec.frontend as frontend

@@ -90,9 +90,8 @@ def test_dispatch_after_close_is_typed_and_never_resurrects():
     with pytest.raises(SchedulerClosed):
         sched.dispatch_frontend(("p",), np.zeros((1, 2, 2, 3),
                                                  np.uint8))
-    dt = sched._device_thread
-    assert dt is None or not dt.is_alive(), \
-        "device thread resurrected after close()"
+    assert not sched.device_threads_alive(), \
+        "device worker resurrected after close()"
 
 
 def test_inflight_group_completes_and_queued_job_drains_typed():
