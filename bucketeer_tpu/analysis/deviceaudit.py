@@ -261,6 +261,29 @@ def registry() -> list:
     entries.append(AuditProgram(
         "decode.coeffs.dequant/gray-irreversible-L2",
         dq_entry(False, (0.5,) * 7, dq_shapes)))
+
+    # Batch data plane (bucketeer_tpu/batches/): the merged dequant as
+    # the scheduler's _launch_dequant actually runs it — the same
+    # program with the group's images stacked along a leading batch
+    # axis. Donation carries over verbatim: reversible stays
+    # int32->int32 (declared, aliased per band), irreversible drops the
+    # alias (float32 outputs match no input aval).
+    from ..batches import batch_mesh_program
+
+    def bdq_entry(reversible, deltas, shapes):
+        def build():
+            fn, donate = batch_mesh_program(reversible, deltas)
+            return fn, donate, [sds(s, jnp.int32) for s in shapes]
+        return build
+
+    bdq_shapes = tuple((4,) + s for s in dq_shapes)
+    entries.append(AuditProgram(
+        "batch.assemble.dequant/gray-reversible-L2/B4",
+        bdq_entry(True, (1.0,) * 7, bdq_shapes),
+        donate_reason="declared"))
+    entries.append(AuditProgram(
+        "batch.assemble.dequant/gray-irreversible-L2/B4",
+        bdq_entry(False, (0.5,) * 7, bdq_shapes)))
     return entries
 
 

@@ -281,6 +281,33 @@ def mesh_registry() -> list:
         return sm, ins, args
     entries.append(MeshProgram("shard.cxdmq.fused.data/L2/N8",
                                fused_entry))
+
+    # The batch data plane's assembled output (bucketeer_tpu/batches/):
+    # the batched dequant with every band's leading batch axis on the
+    # batch mesh — images are independent and the program is
+    # elementwise per band, so a clean lowering has ZERO collectives;
+    # any partitioner-inserted all-gather means the placement contract
+    # (NamedSharding(mesh, P("batch")) end to end) broke somewhere.
+    def batch_dequant_entry(reversible, deltas):
+        def build():
+            import numpy as np
+            from jax.sharding import Mesh
+
+            from ..batches import BATCH_AXIS, batch_mesh_program
+            devices = np.asarray(jax.devices()[:MESH_DEVICES])
+            mesh = Mesh(devices, (BATCH_AXIS,))
+            fn, _donate = batch_mesh_program(reversible, deltas)
+            shapes = ((8, 1, 16, 16),) * 4 + ((8, 1, 32, 32),) * 3
+            ins = tuple(NamedSharding(mesh, P(BATCH_AXIS))
+                        for _ in shapes)
+            return fn, ins, [sds(s, jnp.int32) for s in shapes]
+        return build
+    entries.append(MeshProgram(
+        "batch.assemble.dequant/gray-rev-L2/B8",
+        batch_dequant_entry(True, (1.0,) * 7)))
+    entries.append(MeshProgram(
+        "batch.assemble.dequant/gray-irrev-L2/B8",
+        batch_dequant_entry(False, (0.5,) * 7)))
     return entries
 
 

@@ -54,6 +54,7 @@ def warm_imports() -> None:
     from ...server import metrics  # noqa: F401
     from ... import obs  # noqa: F401  (graftscope span rings)
     from ... import tensor  # noqa: F401  (submit_tensor's services seam)
+    from ... import batches  # noqa: F401  (batchread's dequant seam)
 
 
 class _FakePending:
@@ -598,6 +599,151 @@ def device_pool_storm(ctl):
             assert job.result is not None, job
     assert not sched_c.device_threads_alive()
     for sink in (sink_a, sink_b, sink_c):
+        _ledger(sink)
+
+
+@scenario("batch_fanout_vs_read")
+def batch_fanout_vs_read(ctl):
+    """The batch data plane's device-queue contract (ISSUE 19), three
+    phases on fresh pools:
+
+    - an interactive read queued behind a held worker launches before
+      every queued batch-item dequant in all schedules (batch reads sit
+      between reads and bulk encodes on the priority ladder), and the
+      sibling dequant jobs merge into ONE launch behind it;
+    - a batch whose fan-out is mid-flight when the scheduler closes
+      drains typed: every queued per-item job gets SchedulerClosed (or
+      its result), no waiter hangs, no pool worker is left alive — a
+      cancelled batch can neither strand workers nor leak queued
+      per-item jobs;
+    - the per-device batchread launch ledger sums exactly to the
+      family total in every interleaving.
+    """
+    from ...engine.scheduler import (PRIORITY_BATCH, PRIORITY_READ,
+                                     SchedulerClosed, _DequantJob,
+                                     _DeviceJob)
+
+    tiles = np.zeros((1, 4, 4, 3), dtype=np.uint8)
+    bands = [np.zeros((1, 4, 4), np.int32)]
+    launches = []
+    started = {}
+    gates = {}
+
+    def feed_launch(plan, payload, mode="rows"):
+        seam.yield_point("feed-launch")
+        if mode == "dequant":
+            launches.append(("dequant", len(payload)))
+            return ("dequant-res", len(payload))
+        if plan[0] == "hold":
+            started[plan[1:]].set()
+            gates[plan[1]].wait()
+        launches.append(plan)
+        return _FakePending(len(payload))
+
+    def _hold_plan(gkey, i):
+        started[(gkey, i)] = seam.make_event(f"scenario.start.{gkey}{i}")
+        gates.setdefault(gkey, seam.make_event(f"scenario.gate.{gkey}"))
+        return ("hold", gkey, i)
+
+    def _ledger(sink):
+        counters = sink.report().get("counters", {})
+        total = counters.get("batchread.device_launches", 0)
+        per_dev = sum(v for k, v in counters.items()
+                      if k.startswith("batchread.device_launches.d"))
+        assert per_dev == total, counters
+
+    hold_errs = []
+
+    def hold_client(sched, plan):
+        try:
+            sched.dispatch_frontend(plan, tiles)
+        except Exception as exc:  # graftlint: disable=swallowed-exception
+            hold_errs.append(exc)
+
+    # Phase A: priority ladder around a held single-worker pool. The
+    # wave is enqueued directly while the worker is mid-launch so its
+    # queue order is deterministic (a dispatch per job would need one
+    # blocked thread each and a banned depth spin-wait).
+    sched_a, sink_a = _mk_sched(devices=1, window_s=0)
+    sched_a.launch_fn = feed_launch
+    plan_a = _hold_plan("a", 0)
+    ha = ctl.spawn(lambda: hold_client(sched_a, plan_a), "hold-a")
+    started[("a", 0)].wait()
+    dq_jobs = [_DequantJob(True, (1.0,), bands, expected=2)
+               for _ in range(2)]
+    rd_job = _DeviceJob(("read",), tiles, "rows", 1,
+                        priority=PRIORITY_READ)
+    bulk_job = _DeviceJob(("bulk",), tiles, "rows", 1,
+                          priority=PRIORITY_BATCH)
+    with sched_a._dq_cv:
+        # Bulk encode first in FIFO order: only priority can put the
+        # read in front and the dequants in between.
+        for job in [bulk_job] + dq_jobs + [rd_job]:
+            job.seq = next(sched_a._dseq)
+            sched_a._djobs.append(job)
+        sched_a._dq_cv.notify_all()
+    gates["a"].set()
+    for job in dq_jobs + [rd_job, bulk_job]:
+        job.event.wait()
+        assert job.error is None, job.error
+    ha.join()
+    assert hold_errs == [], hold_errs
+    wave = [p for p in launches if p[0] in ("read", "bulk", "dequant")]
+    # Read first, merged dequant pair second, bulk encode last — the
+    # whole ladder in one schedule-independent order.
+    assert wave == [("read",), ("dequant", 2), ("bulk",)], wave
+    assert dq_jobs[0].result == (("dequant-res", 2), 2), dq_jobs[0].result
+    sched_a.close()
+
+    # Phase B: close() racing a gate release with the fan-out queued —
+    # the cancelled batch's per-item jobs drain typed on a 2-device
+    # pool (one worker held, one racing the closer).
+    launches.clear()
+    sched_b, sink_b = _mk_sched(devices=2, window_s=0)
+    sched_b.launch_fn = feed_launch
+    plan_b = _hold_plan("b", 0)
+    hb = ctl.spawn(lambda: hold_client(sched_b, plan_b), "hold-b")
+    started[("b", 0)].wait()
+    queued = [_DequantJob(True, (1.0,), bands, expected=3)
+              for _ in range(3)]
+    with sched_b._dq_cv:
+        for job in queued:
+            job.seq = next(sched_b._dseq)
+            sched_b._djobs.append(job)
+        sched_b._dq_cv.notify_all()
+    out = {}
+
+    def item_client():
+        # One item arriving through the real dispatch path while the
+        # pool shuts down: typed outcome, never a hang.
+        try:
+            out["item"] = sched_b.dispatch_dequant(
+                True, (1.0,), bands, _expected=3)
+        except SchedulerClosed:
+            out["item"] = "closed"
+
+    ti = ctl.spawn(item_client, "item-client")
+
+    def closer():
+        gates["b"].set()
+        sched_b.close()
+
+    tc = ctl.spawn(closer, "closer")
+    hb.join()
+    ti.join()
+    tc.join()
+    assert hold_errs == [], hold_errs
+    assert out.get("item") == "closed" or out.get("item") is not None, out
+    for job in queued:
+        assert job.event.is_set(), "queued batch item stranded at close()"
+        if job.error is not None:
+            assert isinstance(job.error, SchedulerClosed), job.error
+        else:
+            assert job.result is not None, job
+    with sched_b._dq_cv:
+        assert sched_b._djobs == [], "queued per-item jobs leaked"
+    assert not sched_b.device_threads_alive()
+    for sink in (sink_a, sink_b):
         _ledger(sink)
 
 

@@ -1,0 +1,370 @@
+"""Batch assembly: fan-out, merged dequant, sharded placement.
+
+One admitted ``kind="batchread"`` request produces one
+:class:`BatchResult`: per-item coefficient decodes fan out across a
+thread pool (each rides the scheduler's device queue as a
+``_DequantJob``, where compatible launches from sibling items merge
+into one combined device program), and the surviving items assemble
+into ONE per-subband batched tensor placed with
+``NamedSharding(mesh, P("batch"))`` (SNIPPETS.md [2]) — bit-exact
+against stacking per-image :func:`decode_to_coefficients` calls,
+because the dequant program is elementwise per band.
+
+Failure ladder (the production contract):
+
+- unknown ids / mixed geometry / reduce beyond the coded levels /
+  dtype mismatch — the *request* is wrong: typed
+  :class:`InvalidParam`, detected by cheap main-header probes before
+  any Tier-1 work runs;
+- a corrupt item mid-decode — per-item typed failure in the batch
+  manifest (``ok: false`` + error type), never all-or-nothing; only a
+  batch with zero survivors raises :class:`DecodeError`;
+- deadline expiry / scheduler shutdown — batch-fatal: the fan-out is
+  drained (no pool worker stranded, no queued per-item job leaked —
+  graftrace scenario ``batch_fanout_vs_read`` pins this) and the
+  typed error propagates to the admission layer.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .. import obs
+from ..codec.decode import parser
+from ..codec.decode.errors import DecodeError, InvalidParam
+from ..engine.scheduler import DeadlineExceeded, SchedulerClosed
+from ..tensor import coeffs as tcoeffs
+from .recipe import BatchRecipe
+
+BATCH_AXIS = "batch"
+
+# Fan-out width: item decode threads per batch. Tier-1 is host work,
+# so past the device-pool size extra threads only deepen the dequant
+# merge window's fill — small by default.
+_FANOUT = int(os.environ.get("BUCKETEER_BATCH_FANOUT", "8"))
+
+_SINK = None
+
+# One persistent fan-out pool for every batch: thread startup costs
+# ~10ms of GIL-contended wall each on this class of host, which a
+# per-request executor pays N times per batch — straight off the
+# margin over decode-then-stack.
+_POOL = None
+_POOL_LOCK = threading.Lock()
+
+
+def _fanout_pool() -> ThreadPoolExecutor:
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = ThreadPoolExecutor(
+                max_workers=max(1, _FANOUT),
+                thread_name_prefix="batchread")
+        return _POOL
+
+
+def set_metrics_sink(sink) -> None:
+    """Install the Metrics sink batch assembly records into (item
+    failure counts, assembly seconds) — same pattern as
+    tensor.codec.set_metrics_sink."""
+    global _SINK
+    _SINK = sink
+
+
+@dataclass
+class BatchResult:
+    """One assembled batch: ``bands`` maps each subband key to a
+    ``(N, C, H_b, W_b)`` device array whose leading axis is the batch,
+    placed per ``layout`` (``sharded`` = ``P("batch")`` over the batch
+    mesh, ``replicated`` = every device holds the full batch).
+    ``ids`` are the surviving items in batch order — row ``i`` of every
+    band belongs to ``ids[i]``; ``manifest`` records every *recipe*
+    item, failed ones with their typed error."""
+    ids: tuple
+    bands: dict                  # (res, name) -> (N, C, Hb, Wb)
+    deltas: dict                 # (res, name) -> quantizer step
+    manifest: list               # [{"id", "ok", ["error", "message"]}]
+    meta: dict = field(default_factory=dict)
+    layout: str = "replicated"
+
+    @property
+    def n_items(self) -> int:
+        return len(self.ids)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.bands.values())
+
+    def to_host(self) -> dict:
+        """Materialize every batched band on host — the batch plane's
+        one sanctioned device->host seam (rules_jax.D2H_SANCTIONED);
+        training consumers keep the sharded device arrays instead."""
+        import jax
+
+        return {key: np.asarray(jax.device_get(arr))
+                for key, arr in self.bands.items()}
+
+
+def batch_mesh_program(reversible: bool, deltas: tuple):
+    """(traceable fn, donate_argnums) for the *batched* dequant as the
+    merged device launch runs it — audit seam (analysis/deviceaudit.py
+    ``batch.assemble.dequant`` entries, and graftmesh's sharded
+    lowering under the forced 8-device mesh: elementwise per band, so
+    the expected collective set is empty). Identical program to
+    :func:`tensor.coeffs.dequant_program`; the batch axis rides the
+    shape polymorphism."""
+    return tcoeffs.dequant_program(reversible, deltas)
+
+
+def _error_entry(image_id: str, exc: BaseException) -> dict:
+    return {"id": image_id, "ok": False,
+            "error": type(exc).__name__, "message": str(exc)}
+
+
+def _probe_items(recipe: BatchRecipe, blobs: dict):
+    """Cheap main-header pass over every item before any Tier-1 work:
+    request-shaped problems (mixed geometry, reduce beyond levels,
+    dtype mismatch) become one typed InvalidParam; per-item corrupt
+    headers become upfront manifest failures. Returns (ok ids,
+    manifest entries for the failures, reference geometry)."""
+    geom = {}
+    failed = []
+    for image_id in recipe.ids:
+        try:
+            geom[image_id] = parser.probe(blobs[image_id])
+        except DecodeError as exc:
+            failed.append(_error_entry(image_id, exc))
+    ok_ids = [i for i in recipe.ids if i in geom]
+    if not ok_ids:
+        raise DecodeError(
+            "every item in the batch failed the header probe")
+
+    sigs = {i: (g["width"], g["height"], g["n_comps"], g["levels"],
+                g["reversible"]) for i, g in geom.items()}
+    ref_id = ok_ids[0]
+    ref = sigs[ref_id]
+    mixed = sorted(i for i in ok_ids if sigs[i] != ref)
+    if mixed:
+        raise InvalidParam(
+            f"mixed geometry: {', '.join(mixed)} differ from "
+            f"{ref_id} (batch items must share width/height/"
+            f"components/levels/reversibility)")
+    if recipe.reduce > ref[3]:
+        raise InvalidParam(
+            f"reduce={recipe.reduce} beyond the {ref[3]} coded "
+            f"decomposition levels")
+    want = {"int32": True, "float32": False}.get(recipe.dtype)
+    if want is not None and ref[4] != want:
+        have = "int32" if ref[4] else "float32"
+        raise InvalidParam(
+            f"dtype={recipe.dtype} but the codestreams are "
+            f"{'reversible' if ref[4] else 'irreversible'} ({have})")
+    if recipe.region is not None:
+        x, y, w, h = recipe.region
+        if x >= ref[0] or y >= ref[1]:
+            raise InvalidParam(
+                f"region origin ({x}, {y}) outside the "
+                f"{ref[0]}x{ref[1]} image")
+    return ok_ids, failed, geom[ref_id]
+
+
+def _placement(n: int, layout: str):
+    """The batch mesh + sharding for an ``n``-item batch: a 1-D
+    ``("batch",)`` mesh over every visible device, ``P("batch")`` when
+    the batch divides it (SNIPPETS.md [2] rule), replicated otherwise.
+    ``layout="sharded"`` fails closed instead of falling back."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = np.asarray(jax.devices())
+    mesh = Mesh(devices, (BATCH_AXIS,))
+    if layout == "replicated":
+        return mesh, NamedSharding(mesh, P()), "replicated"
+    divides = n % len(devices) == 0
+    if layout == "sharded" and not divides:
+        raise InvalidParam(
+            f"layout=sharded but the {n}-item batch does not divide "
+            f"the {len(devices)}-device mesh")
+    if divides:
+        return mesh, NamedSharding(mesh, P(BATCH_AXIS)), "sharded"
+    return mesh, NamedSharding(mesh, P()), "replicated"
+
+
+@functools.lru_cache(maxsize=1)
+def _stack_fn():
+    """One fused stack program for every band at once: each band's
+    per-item arrays concatenate along the new batch axis in a single
+    dispatch, instead of one jnp.stack per band. It runs where the
+    inputs live (the dequant pool device); mesh placement is the
+    device_put that follows — jit with ``out_shardings`` would reject
+    the pool-committed inputs on a multi-device mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda parts: [jnp.stack(p) for p in parts])
+
+
+@functools.lru_cache(maxsize=1)
+def _gather_fn():
+    """The fast assembly path when one merged dequant launch covered
+    the whole batch: every item's bands are :class:`BandSlice` views
+    of one shared batched output, so assembly is a single fused
+    row-gather (batch order, surviving rows only) — no per-image slice
+    or re-stack dispatches at all."""
+    import jax
+
+    return jax.jit(lambda parts, idx: [p[idx] for p in parts])
+
+
+def assemble_batch(recipe: BatchRecipe, *, data_for=None) -> BatchResult:
+    """Assemble one batch under the CALLER's admission: run this
+    through ``scheduler.submit_batchread`` so the deadline hook and the
+    merged-dequant launch hook are installed (``coeff_services``) —
+    standalone calls still work, with inline dequant and no deadline.
+
+    ``data_for(image_id)`` returns the item's JP2/JPX bytes or None
+    for unknown ids (the server binds the derivative store; tests and
+    bench bind dicts)."""
+    import time as _time
+
+    if data_for is None:
+        from ..converters import derivative_path
+
+        def data_for(image_id):
+            path = derivative_path(image_id)
+            if path is None or not os.path.exists(path):
+                return None
+            with open(path, "rb") as fh:
+                return fh.read()
+
+    t0 = _time.perf_counter()
+    blobs, unknown = {}, []
+    for image_id in recipe.ids:
+        data = data_for(image_id)
+        if data is None:
+            unknown.append(image_id)
+        else:
+            blobs[image_id] = data
+    if unknown:
+        raise InvalidParam(f"unknown image ids: {', '.join(unknown)}")
+
+    ok_ids, upfront_failed, _ = _probe_items(recipe, blobs)
+
+    # The admitted request thread owns the scheduler hooks
+    # (thread-locals): capture them here, re-install in every item
+    # worker with the fan-out width bound so the device worker's merge
+    # window knows how many compatible dequant launches to wait for.
+    check, launch = tcoeffs.current_services()
+    n = len(ok_ids)
+    # Only min(n, fan-out width) items decode concurrently, so that is
+    # the most compatible dequant launches the merge window can ever
+    # see at once — advertising n would burn the window waiting for
+    # stragglers that cannot arrive.
+    expected = min(n, max(1, _FANOUT))
+    bound_launch = None
+    if launch is not None:
+        def bound_launch(reversible, deltas, arrays):
+            return launch(reversible, deltas, arrays,
+                          _expected=expected)
+    parent_ctx = obs.current_context()
+    request_id = obs.current_request_id()
+
+    def decode_item(idx: int):
+        image_id = ok_ids[idx]
+        with obs.request_context(request_id), \
+                obs.use_context(parent_ctx), \
+                obs.span("batchread.item", image_id=image_id,
+                         index=idx), \
+                tcoeffs.coeff_services(check=check,
+                                       launch=bound_launch):
+            return tcoeffs.decode_to_coefficients(
+                blobs[image_id], region=recipe.region,
+                reduce=recipe.reduce, layers=recipe.layers)
+
+    sets: list = [None] * n
+    failures: dict = {}
+    fatal: BaseException | None = None
+    futs = {_fanout_pool().submit(decode_item, i): i
+            for i in range(n)}
+    # The result loop waits on EVERY item, fatal or not: a batch-fatal
+    # error never leaves a pool worker holding a queued dequant job
+    # the caller no longer waits for.
+    for fut in futs:
+        i = futs[fut]
+        try:
+            sets[i] = fut.result()
+        except (DeadlineExceeded, SchedulerClosed) as exc:
+            fatal = fatal or exc
+        except DecodeError as exc:
+            failures[i] = _error_entry(ok_ids[i], exc)
+            if _SINK is not None:
+                _SINK.count("batchread.item_failures")
+    if fatal is not None:
+        raise fatal
+
+    manifest = list(upfront_failed)
+    kept_ids, kept_sets = [], []
+    for i, image_id in enumerate(ok_ids):
+        if i in failures:
+            manifest.append(failures[i])
+        else:
+            manifest.append({"id": image_id, "ok": True})
+            kept_ids.append(image_id)
+            kept_sets.append(sets[i])
+    # Manifest rows in recipe order, like the batch axis.
+    order = {image_id: k for k, image_id in enumerate(recipe.ids)}
+    manifest.sort(key=lambda e: order[e["id"]])
+    if not kept_sets:
+        raise DecodeError("every item in the batch failed to decode")
+
+    ref = kept_sets[0]
+    mesh, sharding, layout = _placement(len(kept_sets), recipe.layout)
+    with obs.span("batchread.assemble", items=len(kept_sets),
+                  layout=layout, bands=len(ref.bands)):
+        keys = list(ref.bands)
+        cols = [[cs.bands[key] for cs in kept_sets] for key in keys]
+        shared = all(
+            isinstance(v, tcoeffs.BandSlice)
+            and v.parent is col[0].parent
+            for col in cols for v in col)
+        if shared:
+            # Every item rode ONE merged dequant launch: gather its
+            # rows out of the shared batched output in batch order.
+            idx = np.asarray([v.index for v in cols[0]],
+                             dtype=np.int32)
+            stacked = _gather_fn()(
+                [col[0].parent for col in cols], idx)
+        else:
+            # Items landed in different launches (window split,
+            # partial failure mid-wave): stack per item. One fused
+            # device program — device-to-device, no host round-trip.
+            stacked = _stack_fn()(
+                [[v.materialize()
+                  if isinstance(v, tcoeffs.BandSlice) else v
+                  for v in col] for col in cols])
+        # Mesh placement last: the stack/gather ran on the dequant
+        # pool device, device_put reshards onto the batch mesh (a
+        # no-op when the mesh IS that device).
+        import jax
+
+        bands = dict(zip(keys, jax.device_put(stacked, sharding)))
+
+    meta = {"width": ref.width, "height": ref.height,
+            "n_comps": ref.n_comps, "bitdepth": ref.bitdepth,
+            "levels": ref.levels, "reduce": ref.reduce,
+            "reversible": ref.reversible, "used_mct": ref.used_mct,
+            "region": recipe.region, "layers": recipe.layers,
+            "n_devices": len(mesh.devices.flat)}
+    if _SINK is not None:
+        _SINK.count("batchread.batches")
+        _SINK.count("batchread.items", len(kept_sets))
+        _SINK.record("batchread.assemble",
+                     _time.perf_counter() - t0)
+    return BatchResult(ids=tuple(kept_ids), bands=bands,
+                       deltas=dict(ref.deltas), manifest=manifest,
+                       meta=meta, layout=layout)

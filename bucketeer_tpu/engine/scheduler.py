@@ -115,6 +115,10 @@ LOG = logging.getLogger(__name__)
 
 PRIORITY_READ = -1       # interactive tile/region reads outrank encodes
 PRIORITY_SINGLE = 0      # interactive single-image requests
+PRIORITY_BATCHREAD = 0   # batch coefficient reads: strictly after
+                         # interactive reads, strictly ahead of bulk
+                         # encode/tensor batch items (graftrace scenario
+                         # batch_fanout_vs_read pins both edges)
 PRIORITY_BATCH = 1       # CSV batch items yield to interactive traffic
 PRIORITY_TENSOR = 1      # tensor-codec jobs: batch-class, never ahead
                          # of interactive reads (graftrace scenario
@@ -127,6 +131,14 @@ _MAX_BATCH_TILES = int(os.environ.get("BUCKETEER_SCHED_MAX_BATCH_TILES",
 # Same bound for merged tensor-codec chunks, in code-blocks.
 _MAX_BATCH_BLOCKS = int(os.environ.get(
     "BUCKETEER_SCHED_MAX_BATCH_BLOCKS", "128"))
+# And for merged coefficient-dequant launches, in images: each image
+# contributes one full set of per-band planes, so the HBM staging is
+# images x (sum of band planes).
+_MAX_BATCH_IMAGES = int(os.environ.get(
+    "BUCKETEER_SCHED_MAX_BATCH_IMAGES", "16"))
+
+_STAGE_CAPS = {"frontend": _MAX_BATCH_TILES, "tensor": _MAX_BATCH_BLOCKS,
+               "dequant": _MAX_BATCH_IMAGES}
 
 
 class QueueFull(RuntimeError):
@@ -234,6 +246,41 @@ class _TensorJob:
     @property
     def size(self) -> int:
         return self.n_blocks
+
+
+@dataclass
+class _DequantJob:
+    """One image's coefficient-dequant launch request (batch read
+    fan-out). The dequant program is elementwise per band, so
+    merge-compatible jobs (same reversibility + deltas + band shapes)
+    are stacked along a new leading batch axis and launched once; each
+    request's slice of the batched output is bit-identical to a solo
+    launch. ``expected`` hints the merge window: one batchread request
+    contributes N of these concurrently, so the worker waits for up to
+    ``expected`` compatible peers rather than the running-request count
+    (which would cut the window at group size 1)."""
+    reversible: bool
+    deltas: tuple
+    arrays: list
+    expected: int = 1
+    ctx: object = None
+    priority: int = PRIORITY_BATCHREAD
+    seq: int = 0
+    event: threading.Event = field(
+        default_factory=lambda: seam.make_event("DequantJob.event"))
+    result: object = None
+    error: BaseException | None = None
+
+    stage = "dequant"
+
+    @property
+    def key(self):
+        return ("dequant", self.reversible, self.deltas,
+                tuple(a.shape for a in self.arrays))
+
+    @property
+    def size(self) -> int:
+        return 1
 
 
 @dataclass
@@ -598,6 +645,15 @@ class EncodeScheduler:
                             _priority=ticket.priority)):
                     with self._device_ctx(kind):
                         return fn(*args, **kwargs)
+            if kind == "batchread":
+                from ..tensor import coeff_services
+                with coeff_services(
+                        check=check,
+                        launch=functools.partial(
+                            self.dispatch_dequant,
+                            _priority=ticket.priority)):
+                    with self._device_ctx(kind):
+                        return fn(*args, **kwargs)
             if kind != "encode":
                 from ..codec.decode import t1_dec
                 with t1_dec.decode_services(check=check):
@@ -645,6 +701,23 @@ class EncodeScheduler:
         pool launch."""
         return self.submit(fn, *args, priority=priority,
                            deadline_s=deadline_s, kind="tensor",
+                           **kwargs)
+
+    def submit_batchread(self, fn, *args,
+                         priority: int = PRIORITY_BATCHREAD,
+                         deadline_s: float | None = None, **kwargs):
+        """Run a batch coefficient read (batches/assemble.py) through
+        the shared admission queue as ONE admitted request: admission,
+        deadline and queue-wait accounting happen at batch granularity,
+        while the per-image dequant fan-out inside rides the device
+        queue as :class:`_DequantJob` entries without per-item
+        admission (per-item tickets could deadlock the slot queue
+        against the batch's own ticket). Batch reads sit strictly
+        after interactive reads and strictly ahead of bulk
+        encode/tensor work in both the slot queue and the device
+        queue."""
+        return self.submit(fn, *args, priority=priority,
+                           deadline_s=deadline_s, kind="batchread",
                            **kwargs)
 
     def encode_array(self, img, bitdepth: int = 8, params=None,
@@ -859,6 +932,38 @@ class EncodeScheduler:
         seam.read(job, "result")
         return job.result
 
+    def dispatch_dequant(self, reversible: bool, deltas: tuple,
+                         arrays: list, *,
+                         _priority: int = PRIORITY_BATCHREAD,
+                         _expected: int = 1):
+        """The coefficient reader's dequant hook (coeff_services
+        ``launch``): queue one image's per-band dequant on the pool and
+        block for its slice of the (possibly merged) launch — a tuple
+        of device arrays, one per band, shaped exactly like the inline
+        dispatch. ``_expected`` is the submitting batch's fan-out width
+        (the merge window's fill target)."""
+        self._ensure_workers()
+        job = _DequantJob(reversible, tuple(deltas),
+                          [np.asarray(a) for a in arrays],
+                          expected=max(1, int(_expected)),
+                          ctx=obs.current_context(),
+                          priority=_priority)
+        with self._dq_cv:
+            seam.read(self, "_stop")
+            if self._stop:
+                raise SchedulerClosed("scheduler is closed")
+            job.seq = next(self._dseq)
+            seam.write(self, "_djobs")
+            self._djobs.append(job)
+            self._scale_up_locked()
+            self._dq_cv.notify_all()
+        job.event.wait()
+        seam.read(job, "error")
+        if job.error is not None:
+            raise job.error
+        seam.read(job, "result")
+        return job.result
+
     def dispatch_t1(self, fn, payload=None, *,
                     _priority: int = PRIORITY_SINGLE):
         """Pipeline-stage hook: run ``fn(payload)`` (the fused CX/D+MQ
@@ -947,12 +1052,13 @@ class EncodeScheduler:
         worker takes everything (a free device is a free device). Split
         engaged: front-end workers [0, split) never touch staged Tier-1
         work and vice versa — disjoint subsets are what makes the
-        mapping a pipeline. Merged tensor chunks ride either subset."""
+        mapping a pipeline. Merged tensor and dequant chunks ride
+        either subset."""
         if self._split is None:
-            return ("frontend", "tensor", "t1")
+            return ("frontend", "tensor", "dequant", "t1")
         if widx < self._split:
-            return ("frontend", "tensor")
-        return ("t1", "tensor")
+            return ("frontend", "tensor", "dequant")
+        return ("t1", "tensor", "dequant")
 
     def _pop_job_locked(self, widx: int):
         """Pop the highest-priority (then FIFO) queued job this worker
@@ -990,10 +1096,10 @@ class EncodeScheduler:
         group (the _locked suffix is the codebase convention for
         "caller holds the lock" — here the queue cv; the lock-discipline
         lint, analysis/rules_locks.py, keys on it). Returns the group
-        size total (tiles for frontend groups, blocks for tensor)."""
+        size total (tiles for frontend groups, blocks for tensor,
+        images for dequant)."""
         lead = group[0]
-        cap = (_MAX_BATCH_TILES if lead.stage == "frontend"
-               else _MAX_BATCH_BLOCKS)
+        cap = _STAGE_CAPS.get(lead.stage, _MAX_BATCH_BLOCKS)
         key = lead.key
         total = sum(j.size for j in group)
         kept: list = []
@@ -1048,7 +1154,7 @@ class EncodeScheduler:
                 # dispatch_t1 stagers (and idle peers re-check).
                 self._dq_cv.notify_all()
                 group = [job]
-                mergeable = (job.stage == "tensor"
+                mergeable = (job.stage in ("tensor", "dequant")
                              or (job.stage == "frontend"
                                  and job.mode == "rows"))
                 if mergeable and self.window_s > 0 and \
@@ -1057,24 +1163,35 @@ class EncodeScheduler:
                     # co-batchable chunks while other running requests
                     # could still contribute one — but only while no
                     # idle peer device could take them instead.
-                    cap = (_MAX_BATCH_TILES if job.stage == "frontend"
-                           else _MAX_BATCH_BLOCKS)
+                    cap = _STAGE_CAPS.get(job.stage, _MAX_BATCH_BLOCKS)
                     limit = seam.monotonic() + self.window_s
                     while True:
                         total = self._take_compatible_locked(group)
-                        running = self._running_count()
-                        if (len(group) >= max(1, running)
-                                or total >= cap):
-                            break
-                        # Futile-wait cut: if every other running
-                        # request already has an incompatible job
-                        # queued (each blocks on its own dispatch, one
-                        # job per request), nothing mergeable can
-                        # arrive — launch now instead of burning the
-                        # window on their critical path.
-                        if self._djobs and len(self._djobs) >= \
-                                running - len(group):
-                            break
+                        if job.stage == "dequant":
+                            # One batchread request fans out N dequant
+                            # jobs concurrently: the fill target is the
+                            # request's own advertised width, not the
+                            # running-request count (which would cut the
+                            # window at group size 1).
+                            target = min(cap, max(j.expected
+                                                  for j in group))
+                            if len(group) >= target or total >= cap:
+                                break
+                        else:
+                            running = self._running_count()
+                            if (len(group) >= max(1, running)
+                                    or total >= cap):
+                                break
+                            # Futile-wait cut: if every other running
+                            # request already has an incompatible job
+                            # queued (each blocks on its own dispatch,
+                            # one job per request), nothing mergeable
+                            # can arrive — launch now instead of
+                            # burning the window on their critical
+                            # path.
+                            if self._djobs and len(self._djobs) >= \
+                                    running - len(group):
+                                break
                         remaining = limit - seam.monotonic()
                         if remaining <= 0:
                             break
@@ -1094,6 +1211,8 @@ class EncodeScheduler:
                     self._launch(group, widx)
                 elif job.stage == "tensor":
                     self._launch_tensor(group, widx)
+                elif job.stage == "dequant":
+                    self._launch_dequant(group, widx)
                 else:
                     self._launch_t1(job, widx)
             # The _launch* methods deliver per-job errors; anything
@@ -1264,6 +1383,80 @@ class EncodeScheduler:
                 self._sink.count(f"tensor.device_launches.d{widx}")
                 self._sink.count("tensor.batched_blocks", n_blocks)
                 self._sink.observe("tensor.batch_occupancy", len(group))
+            for j in group:
+                if not completed and j.error is None:
+                    seam.write(j, "error")
+                    j.error = RuntimeError("device launch failed")
+                j.event.set()
+
+    def _launch_dequant(self, group: list, widx: int) -> None:
+        """One merged coefficient-dequant launch. The program is
+        elementwise per band: stacking the group's per-band planes
+        along a new leading batch axis and slicing the batched outputs
+        back per image is bit-identical to solo launches (ISSUE 19's
+        bit-exactness acceptance bar rides on this)."""
+        dev = self._devices[widx]
+        lead = group[0]
+        attrs = {"occupancy": len(group), "images": len(group),
+                 "mode": "dequant", "device_id": widx}
+        links = [j.ctx for j in group if j.ctx is not None]
+        completed = False
+        try:
+            with obs.span("device.launch", ctx=None, links=links,
+                          **attrs):
+                if self.launch_fn is not None:
+                    res = self.launch_fn(
+                        None, [j.arrays for j in group], mode="dequant")
+                    for j in group:
+                        seam.write(j, "result")
+                        j.result = (res, len(group))
+                else:
+                    from ..tensor import coeffs as tcoeffs
+                    if len(group) == 1:
+                        seam.write(lead, "result")
+                        lead.result = tcoeffs.run_dequant_inline(
+                            lead.reversible, lead.deltas, lead.arrays,
+                            device=dev)
+                    else:
+                        # Bucket the batch axis to a power of two:
+                        # jit retraces per input shape, so launching
+                        # whatever group size the merge window caught
+                        # (5, then 3, ...) compiles a fresh program
+                        # per size — a multi-hundred-ms stall mid
+                        # request. Padded rows are zeros; the program
+                        # is elementwise, so real rows are untouched.
+                        width = 1 << (len(group) - 1).bit_length()
+                        stacked = []
+                        for b in range(len(lead.arrays)):
+                            plane = np.zeros(
+                                (width,) + lead.arrays[b].shape,
+                                dtype=lead.arrays[b].dtype)
+                            for g, j in enumerate(group):
+                                plane[g] = j.arrays[b]
+                            stacked.append(plane)
+                        outs = tcoeffs.run_dequant_inline(
+                            lead.reversible, lead.deltas, stacked,
+                            device=dev)
+                        # Lazy per-image views of the shared batched
+                        # output: the batch assembler gathers sibling
+                        # views in one fused program instead of paying
+                        # a device slice dispatch per band per image.
+                        for g, j in enumerate(group):
+                            seam.write(j, "result")
+                            j.result = tuple(
+                                tcoeffs.BandSlice(o, g) for o in outs)
+            completed = True
+        except Exception as exc:    # graftlint: disable=swallowed-exception
+            for j in group:
+                seam.write(j, "error")
+                j.error = exc
+        finally:
+            if self._sink is not None:
+                self._sink.count("batchread.device_launches")
+                self._sink.count(f"batchread.device_launches.d{widx}")
+                self._sink.count("batchread.merged_images", len(group))
+                self._sink.observe("batchread.batch_occupancy",
+                                   len(group))
             for j in group:
                 if not completed and j.error is None:
                     seam.write(j, "error")

@@ -18,8 +18,21 @@ Configuration (first match wins):
 from __future__ import annotations
 
 import logging
+import re
 
 LOG = logging.getLogger(__name__)
+
+_CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def _normalize_key(key: str) -> str:
+    """Handler names label the ``http.*`` stages, but operators keep
+    writing OpenAPI operationIds (``postBatches=800``) — normalize
+    camelCase keys to the snake_case handler name (``post_batches``)
+    instead of silently never matching."""
+    if any(ch.isupper() for ch in key):
+        return _CAMEL_RE.sub("_", key).lower()
+    return key
 
 
 class SloWatchdog:
@@ -35,8 +48,10 @@ class SloWatchdog:
     def parse(cls, spec: str | None, sink=None, flight=None
               ) -> "SloWatchdog":
         """Parse a ``default=500,get_image=250`` spec (ms; keys are
-        handler names — the ``http.*`` stage labels in ``/metrics`` —
-        not OpenAPI operationIds). Malformed entries are skipped with
+        handler names — the ``http.*`` stage labels in ``/metrics``.
+        camelCase OpenAPI operationIds like ``postBatches`` are
+        normalized to the handler name). Malformed entries are skipped
+        with
         a warning — a bad SLO string must not take the server down.
         Keys are not validated against the route table here (the
         watchdog has no registry); the server logs the parsed spec at
@@ -51,7 +66,7 @@ class SloWatchdog:
             try:
                 if "=" in part:
                     key, val = part.split("=", 1)
-                    key = key.strip()
+                    key = _normalize_key(key.strip())
                     if key == "default":
                         default = float(val)
                     else:
@@ -67,7 +82,10 @@ class SloWatchdog:
         return self.default_ms is not None or bool(self.per_endpoint)
 
     def threshold_ms(self, endpoint: str) -> float | None:
-        return self.per_endpoint.get(endpoint, self.default_ms)
+        # Lookups normalize like parse() does, so a camelCase label
+        # finds the budget whichever spelling configured it.
+        return self.per_endpoint.get(_normalize_key(endpoint),
+                                     self.default_ms)
 
     def observe(self, endpoint: str, seconds: float,
                 request_id=None) -> bool:

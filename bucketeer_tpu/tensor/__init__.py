@@ -15,8 +15,10 @@ Two products:
 """
 from .codec import (decode_tensor, encode_tensor, set_metrics_sink,
                     tensor_services, tensor_stats, truncate_tensor)
-from .coeffs import CoefficientSet, decode_to_coefficients
+from .coeffs import (CoefficientSet, coeff_services,
+                     decode_to_coefficients)
 
 __all__ = ["encode_tensor", "decode_tensor", "truncate_tensor",
-           "tensor_stats", "tensor_services", "set_metrics_sink",
-           "decode_to_coefficients", "CoefficientSet"]
+           "tensor_stats", "tensor_services", "coeff_services",
+           "set_metrics_sink", "decode_to_coefficients",
+           "CoefficientSet"]

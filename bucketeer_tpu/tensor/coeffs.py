@@ -37,7 +37,9 @@ Subband layout contract (the shape tests pin):
 from __future__ import annotations
 
 import struct
+import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass
 from functools import lru_cache
 
@@ -106,8 +108,54 @@ class CoefficientSet:
         consumers feed the device arrays onward instead."""
         import jax
 
-        return {key: np.asarray(jax.device_get(arr))
+        return {key: np.asarray(jax.device_get(
+                    arr.materialize() if isinstance(arr, BandSlice)
+                    else arr))
                 for key, arr in self.bands.items()}
+
+
+# --- scheduler seam -------------------------------------------------------
+
+_TLS = threading.local()
+
+
+@contextmanager
+def coeff_services(check=None, launch=None):
+    """Install per-thread hooks for the duration of a coefficient read
+    — the coefficient analog of ``tensor_services``:
+
+    - ``check()`` is polled at per-tile Tier-1 boundaries (the
+      scheduler's deadline hook for ``kind="batchread"`` jobs);
+    - ``launch(reversible, deltas, arrays)`` replaces the inline
+      dequant dispatch, so the scheduler can queue the
+      ``decode.coeffs.dequant`` launch on the device pool where
+      compatible launches from concurrent batch items merge into one
+      combined device program (engine/scheduler.py
+      ``dispatch_dequant``). Must return the same tuple of per-band
+      device arrays the inline path produces.
+    """
+    prev = (getattr(_TLS, "check", None), getattr(_TLS, "launch", None))
+    _TLS.check, _TLS.launch = check, launch
+    try:
+        yield
+    finally:
+        _TLS.check, _TLS.launch = prev
+
+
+def _poll() -> None:
+    check = getattr(_TLS, "check", None)
+    if check is not None:
+        check()
+
+
+def current_services() -> tuple:
+    """The calling thread's installed ``(check, launch)`` hooks, or
+    ``(None, None)``. The batch assembler reads these on the admitted
+    request thread and re-installs them (with the fan-out width bound)
+    in each of its item worker threads — thread-locals don't cross the
+    fan-out otherwise."""
+    return (getattr(_TLS, "check", None),
+            getattr(_TLS, "launch", None))
 
 
 # --- the jitted dequant back half ----------------------------------------
@@ -148,10 +196,59 @@ def _compiled_dequant(reversible: bool, deltas: tuple):
     return jax.jit(fn, donate_argnums=donate_argnums_if_supported(*donate))
 
 
+class BandSlice:
+    """One image's row of a merged batched-dequant output: a lazy view
+    ``parent[index]`` the scheduler's combined launch hands back to
+    each fanned-out item instead of paying a device slice dispatch per
+    band per image. The batch assembler recognizes sibling views of
+    one parent and gathers the whole batch in a single fused program;
+    any other consumer materializes transparently via
+    :func:`numpy.asarray`."""
+
+    __slots__ = ("parent", "index")
+
+    def __init__(self, parent, index: int):
+        self.parent = parent
+        self.index = index
+
+    @property
+    def shape(self):
+        return self.parent.shape[1:]
+
+    @property
+    def dtype(self):
+        return self.parent.dtype
+
+    def materialize(self):
+        return self.parent[self.index]
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self.materialize())
+        return arr if dtype is None else arr.astype(dtype)
+
+
 def _run_dequant(reversible: bool, deltas: tuple, arrays: list):
+    launch = getattr(_TLS, "launch", None)
+    if launch is not None:
+        return launch(reversible, deltas, arrays)
+    return run_dequant_inline(reversible, deltas, arrays)
+
+
+def run_dequant_inline(reversible: bool, deltas: tuple, arrays: list,
+                       device=None):
+    """Dispatch the compiled dequantizer directly (bypassing any
+    installed ``coeff_services`` launch hook): the scheduler's merged
+    device launch calls this with the per-image planes stacked along a
+    leading batch axis — the program is elementwise per band, so the
+    batched outputs slice back per image bit-exactly."""
     import jax.numpy as jnp
 
     fn = _compiled_dequant(reversible, deltas)
+    if device is not None:
+        import jax
+
+        return fn(*(jax.device_put(np.asarray(a), device)
+                    for a in arrays))
     return fn(*(jnp.asarray(a) for a in arrays))
 
 
@@ -224,6 +321,7 @@ def _full_impl(data: bytes, reduce: int, layers) -> CoefficientSet:
     n_blocks = n_dec = 0
     t_mq = 0.0
     for tile in ps.tiles:
+        _poll()
         hv, nb, nd, tm, _ = decoder_mod._tile_hvals(ps, tile, reduce)
         n_blocks += nb
         n_dec += nd
@@ -342,6 +440,7 @@ def _region_impl(data: bytes, reduce: int, layers, region,
     n_blocks = n_dec = 0
     t_mq = 0.0
     for tidx, (ty, tx), plan, wins in work:
+        _poll()
         arrays, nb, nd, tm, _ = decoder_mod._tile_region_hvals(
             ps, tiles_by_idx[tidx], reduce, plan)
         n_blocks += nb

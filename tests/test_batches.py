@@ -1,0 +1,379 @@
+"""The batch data plane (ISSUE 19, graftfeed): recipe validation (fuzz
+-> typed InvalidParam, never a 500-shaped crash), batch assembly
+bit-exactness against stacking per-image decode_to_coefficients (int32
+reversible and float32 irreversible, with region/reduce/layers), the
+sharded-vs-replicated placement contract on the conftest-forced
+8-device mesh, per-item partial-failure manifests, the merged dequant
+launch, and the BTB1 stored-container round trip with progressive
+plane truncation and corruption fuzzing."""
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from bucketeer_tpu import batches as batches_mod
+from bucketeer_tpu.batches import (BatchRecipe, assemble_batch,
+                                   decode_batch, encode_batch,
+                                   parse_recipe, truncate_batch)
+from bucketeer_tpu.batches.store import MAGIC, batch_stats
+from bucketeer_tpu.codec import encoder as codec_encoder
+from bucketeer_tpu.codec.decode.errors import DecodeError, InvalidParam
+from bucketeer_tpu.codec.encoder import EncodeParams
+from bucketeer_tpu.engine.scheduler import EncodeScheduler
+from bucketeer_tpu.server.metrics import Metrics
+from bucketeer_tpu.tensor import decode_to_coefficients
+
+
+def _encode(size=32, lossless=True, levels=2, seed=7):
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 256, size=(size, size, 3)).astype(np.uint8)
+    return codec_encoder.encode_jp2(
+        img, 8, EncodeParams(lossless=lossless, levels=levels,
+                             tile_size=size, gen_plt=True), jpx=True)
+
+
+@pytest.fixture(scope="module")
+def blobs8():
+    """Eight compatible reversible 32px codestreams, keyed img0..img7."""
+    return {f"img{i}": _encode(seed=100 + i) for i in range(8)}
+
+
+@pytest.fixture(scope="module")
+def lossy4():
+    """Four compatible irreversible (9/7, float32) codestreams."""
+    return {f"lossy{i}": _encode(lossless=False, seed=200 + i)
+            for i in range(4)}
+
+
+def _oracle(blobs, ids, **kwargs):
+    """Stacked per-image decode_to_coefficients — the ground truth the
+    batch plane must match bit-for-bit."""
+    hosts = [decode_to_coefficients(blobs[i], **kwargs).to_host()
+             for i in ids]
+    return {key: np.stack([h[key] for h in hosts])
+            for key in hosts[0]}
+
+
+def _assert_bitexact(result, expected):
+    got = result.to_host()
+    assert set(got) == set(expected)
+    for key in expected:
+        assert got[key].dtype == expected[key].dtype, key
+        np.testing.assert_array_equal(got[key], expected[key])
+
+
+# --- recipe validation -------------------------------------------------
+
+def test_recipe_parse_roundtrip():
+    r = parse_recipe({"ids": ["a", "b"], "region": [8, 8, 16, 16],
+                      "reduce": 1, "layers": 2, "dtype": "int32",
+                      "layout": "sharded", "store": True, "planes": 4,
+                      "deadline_s": 30})
+    assert r == BatchRecipe(ids=("a", "b"), region=(8, 8, 16, 16),
+                            reduce=1, layers=2, dtype="int32",
+                            layout="sharded", store=True, planes=4,
+                            deadline_s=30.0)
+    assert parse_recipe({"ids": ["x"]}).layout == "auto"
+
+
+@pytest.mark.parametrize("doc", [
+    None, [], "ids", 42,
+    {},                                        # no ids
+    {"ids": []},                               # empty ids
+    {"ids": "img0"},                           # not a list
+    {"ids": [1, 2]},                           # non-string ids
+    {"ids": ["ok", "bad id"]},                 # id fails the charset
+    {"ids": ["a" * 300]},                      # id too long
+    {"ids": [f"i{k}" for k in range(200)]},    # over MAX_ITEMS
+    {"ids": ["a"], "bogus": 1},                # unknown key
+    {"ids": ["a"], "region": [1, 2, 3]},       # 3-tuple region
+    {"ids": ["a"], "region": [0, 0, 0, 5]},    # zero-size region
+    {"ids": ["a"], "region": [-1, 0, 4, 4]},   # negative origin
+    {"ids": ["a"], "region": [0, 0, True, 4]},  # bool is not an int
+    {"ids": ["a"], "region": "0,0,4,4"},       # string region
+    {"ids": ["a"], "reduce": -1},
+    {"ids": ["a"], "reduce": 99},
+    {"ids": ["a"], "reduce": 1.5},
+    {"ids": ["a"], "layers": 0},
+    {"ids": ["a"], "dtype": "int8"},
+    {"ids": ["a"], "layout": "mesh"},
+    {"ids": ["a"], "store": "yes"},
+    {"ids": ["a"], "planes": 4},               # planes without store
+    {"ids": ["a"], "store": True, "planes": 0},
+    {"ids": ["a"], "deadline_s": 0},
+    {"ids": ["a"], "deadline_s": -5},
+    {"ids": ["a"], "deadline_s": 1e9},
+    {"ids": ["a"], "deadline_s": "soon"},
+])
+def test_recipe_fuzz_typed_invalid(doc):
+    with pytest.raises(InvalidParam):
+        parse_recipe(doc)
+
+
+def test_recipe_fuzz_random_mutations():
+    """Seeded garbage over the recipe keyspace: every outcome is a
+    parsed recipe or a typed InvalidParam — never a TypeError/KeyError
+    escaping toward a 500."""
+    rng = np.random.default_rng(17)
+    pool = [None, True, False, -1, 0, 1, 3.7, "x", "", [], {}, ["a"],
+            [0], {"k": 1}, float("nan"), "int32", "sharded", [1, 2, 3, 4]]
+    keys = ["ids", "region", "reduce", "layers", "dtype", "layout",
+            "store", "planes", "deadline_s", "junk"]
+    for _ in range(300):
+        doc = {keys[k]: pool[v] for k, v in zip(
+            rng.integers(0, len(keys), size=rng.integers(0, 6)),
+            rng.integers(0, len(pool), size=6))}
+        try:
+            parse_recipe(doc)
+        except InvalidParam:
+            pass
+
+
+# --- assembly bit-exactness and placement -----------------------------
+
+def test_assemble_reversible_sharded_bitexact(blobs8):
+    """Eight reversible images through an admitted batchread on a real
+    scheduler: int32 bands, bit-exact against per-image decode+stack,
+    placed P("batch") over the conftest-forced 8-device mesh, and the
+    per-image dequants merge into combined device launches."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ids = sorted(blobs8)
+    recipe = BatchRecipe(ids=tuple(ids))
+    sched = EncodeScheduler(queue_depth=16, max_concurrent=8,
+                            devices=1, window_s=0.3)
+    sink = Metrics()
+    sched.set_metrics_sink(sink)
+    try:
+        result = sched.submit_batchread(assemble_batch, recipe,
+                                        data_for=blobs8.get)
+    finally:
+        sched.close()
+
+    assert result.layout == "sharded"
+    assert result.ids == tuple(ids)
+    assert all(e["ok"] for e in result.manifest)
+    for arr in result.bands.values():
+        assert arr.shape[0] == 8
+        sharding = arr.sharding
+        assert isinstance(sharding, NamedSharding)
+        assert sharding.spec == P("batch")
+    assert result.meta["reversible"] is True
+    _assert_bitexact(result, _oracle(blobs8, ids))
+    for key in result.to_host():
+        assert result.to_host()[key].dtype == np.int32
+
+    counters = sink.report()["counters"]
+    # Merging happened: fewer device launches than images rode them.
+    assert counters["batchread.merged_images"] == 8
+    assert counters["batchread.device_launches"] < 8
+
+
+def test_assemble_irreversible_float32_replicated(lossy4):
+    """Four irreversible images: float32 bands, bit-exact (the 9/7
+    dequant is the same elementwise program either path), and under
+    layout=auto a 4-item batch does not divide the 8-device mesh, so
+    placement falls back to replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ids = sorted(lossy4)
+    recipe = BatchRecipe(ids=tuple(ids), dtype="float32")
+    sched = EncodeScheduler(queue_depth=16, max_concurrent=8,
+                            devices=1, window_s=0.3)
+    try:
+        result = sched.submit_batchread(assemble_batch, recipe,
+                                        data_for=lossy4.get)
+    finally:
+        sched.close()
+
+    assert result.layout == "replicated"
+    assert result.meta["reversible"] is False
+    for arr in result.bands.values():
+        assert isinstance(arr.sharding, NamedSharding)
+        assert arr.sharding.spec == P()
+    _assert_bitexact(result, _oracle(lossy4, ids))
+    for key, arr in result.to_host().items():
+        assert arr.dtype == np.float32
+
+
+def test_assemble_region_reduce_layers_standalone(blobs8):
+    """region/reduce/layers apply uniformly to every item, and a
+    standalone call (no scheduler hooks -> inline dequant) is the same
+    bit-exact result as the admitted path."""
+    ids = ["img0", "img3", "img5"]
+    kwargs = dict(region=(8, 8, 16, 16), reduce=1, layers=1)
+    result = assemble_batch(
+        BatchRecipe(ids=tuple(ids), **kwargs), data_for=blobs8.get)
+    assert result.layout == "replicated"     # 3 items on 8 devices
+    assert result.meta["reduce"] == 1
+    _assert_bitexact(result, _oracle(blobs8, ids, **kwargs))
+
+
+def test_assemble_request_shaped_errors(blobs8, lossy4):
+    both = dict(blobs8)
+    both.update(lossy4)
+    both["tiny"] = _encode(size=16, seed=5)
+
+    def run(recipe):
+        return assemble_batch(recipe, data_for=both.get)
+
+    with pytest.raises(InvalidParam, match="unknown image ids"):
+        run(BatchRecipe(ids=("img0", "nope", "gone")))
+    with pytest.raises(InvalidParam, match="mixed geometry"):
+        run(BatchRecipe(ids=("img0", "tiny")))
+    with pytest.raises(InvalidParam, match="mixed geometry"):
+        run(BatchRecipe(ids=("img0", "lossy0")))   # reversibility split
+    with pytest.raises(InvalidParam, match="beyond the"):
+        run(BatchRecipe(ids=("img0", "img1"), reduce=5))
+    with pytest.raises(InvalidParam, match="dtype=float32"):
+        run(BatchRecipe(ids=("img0",), dtype="float32"))
+    with pytest.raises(InvalidParam, match="dtype=int32"):
+        run(BatchRecipe(ids=("lossy0",), dtype="int32"))
+    with pytest.raises(InvalidParam, match="outside the"):
+        run(BatchRecipe(ids=("img0",), region=(64, 0, 8, 8)))
+    with pytest.raises(InvalidParam, match="does not divide"):
+        run(BatchRecipe(ids=("img0", "img1", "img2"),
+                        layout="sharded"))
+
+
+def test_assemble_partial_failure_manifest(blobs8):
+    """A corrupt item fails alone: its manifest row carries the typed
+    error, the surviving rows stay bit-exact and in recipe order."""
+    ids = ["img0", "img1", "img2", "img3"]
+    blobs = {i: blobs8[i] for i in ids}
+    # Past the main header (so the probe passes), then truncated so
+    # Tier-1 hits the cliff mid-codestream.
+    blobs["img2"] = blobs["img2"][:len(blobs["img2"]) // 2]
+
+    result = assemble_batch(BatchRecipe(ids=tuple(ids)),
+                            data_for=blobs.get)
+    assert [e["id"] for e in result.manifest] == ids
+    flags = {e["id"]: e["ok"] for e in result.manifest}
+    assert flags == {"img0": True, "img1": True,
+                     "img2": False, "img3": True}
+    bad = next(e for e in result.manifest if not e["ok"])
+    assert bad["error"] and bad["message"]
+    assert result.ids == ("img0", "img1", "img3")
+    _assert_bitexact(result, _oracle(blobs8, ["img0", "img1", "img3"]))
+
+
+def test_assemble_all_items_failed(blobs8):
+    blobs = {"a": blobs8["img0"][:40], "b": blobs8["img1"][:40]}
+    with pytest.raises(DecodeError):
+        assemble_batch(BatchRecipe(ids=("a", "b")), data_for=blobs.get)
+
+
+# --- the merged dequant launch ----------------------------------------
+
+def test_dequant_launches_merge_to_expected_width():
+    """Three concurrent compatible dequant dispatches with
+    _expected=3 merge into ONE pool launch; each caller still gets its
+    own slice back (stub pool: no JAX, launch identity observable).
+    One device: an idle peer worker cuts the merge window by design
+    (it could take the compatible job instead)."""
+    launches = []
+
+    def stub(plan, arrays, mode="rows"):
+        assert mode == "dequant"
+        launches.append(len(arrays))
+        return "launch-%d" % len(launches)
+
+    sched = EncodeScheduler(queue_depth=8, max_concurrent=4,
+                            devices=1, window_s=2.0)
+    sched.launch_fn = stub
+    try:
+        arrays = [np.arange(6, dtype=np.int32).reshape(2, 3)]
+        fns = [lambda: sched.dispatch_dequant(
+            True, (0.5,), arrays, _expected=3) for _ in range(3)]
+        outs = [None] * 3
+        barrier = threading.Barrier(3)
+
+        def client(i):
+            barrier.wait()
+            outs[i] = fns[i]()
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "dequant dispatch hung"
+    finally:
+        sched.close()
+    assert launches == [3]
+    assert [o for o in outs] == [("launch-1", 3)] * 3
+
+
+def test_band_slice_views():
+    """BandSlice is a transparent lazy row view of the merged batched
+    output: shape/dtype describe the row, materialize and __array__
+    produce it."""
+    from bucketeer_tpu.tensor.coeffs import BandSlice
+
+    parent = np.arange(24, dtype=np.int32).reshape(4, 2, 3)
+    v = BandSlice(parent, 2)
+    assert v.shape == (2, 3)
+    assert v.dtype == np.int32
+    np.testing.assert_array_equal(v.materialize(), parent[2])
+    np.testing.assert_array_equal(np.asarray(v), parent[2])
+    assert np.asarray(v, dtype=np.float64).dtype == np.float64
+
+
+# --- BTB1 stored container --------------------------------------------
+
+@pytest.fixture(scope="module")
+def stored(blobs8):
+    ids = ["img0", "img1", "img2", "img4"]
+    result = assemble_batch(BatchRecipe(ids=tuple(ids)),
+                            data_for=blobs8.get)
+    return result, encode_batch(result)
+
+
+def test_btb1_roundtrip_exact(stored):
+    result, blob = stored
+    assert blob[:4] == MAGIC
+    header, bands = decode_batch(blob)
+    assert header["ids"] == list(result.ids)
+    assert header["layout"] == result.layout
+    assert [e["ok"] for e in header["manifest"]] == [True] * 4
+    host = result.to_host()
+    assert set(bands) == set(host)
+    for key in host:
+        np.testing.assert_array_equal(bands[key], host[key])
+
+
+def test_btb1_progressive_truncation(stored):
+    result, blob = stored
+    cut = truncate_batch(blob, planes=2)
+    assert len(cut) < len(blob)
+    header, bands = decode_batch(cut)
+    # Same geometry, coarser values; a deeper decode-side cut of the
+    # full blob equals decoding the truncated container.
+    _, direct = decode_batch(blob, planes=2)
+    host = result.to_host()
+    for key in host:
+        assert bands[key].shape == host[key].shape
+        np.testing.assert_array_equal(bands[key], direct[key])
+    stats = batch_stats(cut)
+    assert stats["ids"] == list(result.ids)
+    assert stats["n_bands"] == len(host)
+    assert stats["coded_bytes"] == len(cut)
+
+
+@pytest.mark.parametrize("mangle", [
+    lambda b: b[:3],                                   # shorter than magic
+    lambda b: b"XXXX" + b[4:],                         # flipped magic
+    lambda b: b[:4] + struct.pack(">BI", 9, 1) + b[9:],  # bad version
+    lambda b: b[:5] + struct.pack(">I", 1 << 30) + b[9:],  # header overrun
+    lambda b: b[:12] + b"\x00" + b[13:],               # mangled JSON
+    lambda b: b[:len(b) // 2],                         # tail-truncated
+    lambda b: b[:9],                                   # header missing
+])
+def test_btb1_corruption_typed(stored, mangle):
+    _, blob = stored
+    with pytest.raises(DecodeError):
+        decode_batch(mangle(blob))
+    with pytest.raises(DecodeError):
+        truncate_batch(mangle(blob), planes=1)

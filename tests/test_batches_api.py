@@ -1,0 +1,210 @@
+"""The batch data plane's HTTP surface (ISSUE 19): POST /batches
+(JSON recipe -> one npz of batched bands + X-Batch-Meta, or store=true
+-> 201 + a stored BTB1 handle), GET /batches/{id} (npz / raw blob /
+progressive planes=), typed 400s for every malformed recipe, the
+per-item partial-failure manifest, the shared 503 + Retry-After
+admission ladder, and X-Request-Id propagation."""
+import io
+import json
+
+import numpy as np
+import pytest
+
+from bucketeer_tpu import config as cfg
+from bucketeer_tpu import features
+from bucketeer_tpu.codec import encoder as codec_encoder
+from bucketeer_tpu.codec.encoder import EncodeParams
+from bucketeer_tpu.converters import output_path
+from bucketeer_tpu.engine import Engine, FakeS3Client, RecordingSlackClient
+from bucketeer_tpu.server.app import build_app
+
+
+@pytest.fixture
+def env_client(tmp_path, aiohttp_client):
+    async def factory():
+        config = cfg.Config.load(overrides={
+            cfg.IIIF_URL: "http://iiif.test/iiif",
+            cfg.SLACK_CHANNEL_ID: "chan",
+            cfg.FILESYSTEM_CSV_MOUNT: str(tmp_path / "csv-mount"),
+        })
+        engine = Engine(
+            config,
+            flags=features.FeatureFlagChecker(static={}),
+            converter=None,
+            s3_client=FakeS3Client(str(tmp_path / "s3")),
+            slack_client=RecordingSlackClient())
+        app = build_app(engine, job_delete_timeout=0.1)
+        client = await aiohttp_client(app)
+        return client, engine
+
+    return factory
+
+
+def _write_batch_items(tmp_path, monkeypatch, n=2, size=32):
+    """n compatible reversible derivatives on disk; returns
+    (ids, {id: jpx bytes})."""
+    monkeypatch.setenv("BUCKETEER_TMPDIR", str(tmp_path))
+    blobs = {}
+    for i in range(n):
+        rng = np.random.default_rng(300 + i)
+        img = rng.integers(0, 256, size=(size, size, 3)).astype(np.uint8)
+        data = codec_encoder.encode_jp2(
+            img, 8, EncodeParams(lossless=True, levels=2,
+                                 tile_size=size, gen_plt=True), jpx=True)
+        image_id = f"batch-img{i}"
+        with open(output_path(image_id, ".jpx"), "wb") as fh:
+            fh.write(data)
+        blobs[image_id] = data
+    return sorted(blobs), blobs
+
+
+def _unkey(key: str):
+    res, name = key.split("_")
+    return (int(res[1:]), name)
+
+
+async def test_post_batches_npz(tmp_path, env_client, monkeypatch):
+    from bucketeer_tpu.tensor import decode_to_coefficients
+
+    ids, blobs = _write_batch_items(tmp_path, monkeypatch)
+    client, _ = await env_client()
+    resp = await client.post("/batches", json={"ids": ids},
+                             headers={"X-Request-Id": "batch-req-1"})
+    assert resp.status == 200
+    assert resp.headers["X-Request-Id"] == "batch-req-1"
+    meta = json.loads(resp.headers["X-Batch-Meta"])
+    assert meta["ids"] == ids
+    assert meta["layout"] == "replicated"      # 2 items, 8 devices
+    assert [e["ok"] for e in meta["manifest"]] == [True, True]
+    assert meta["meta"]["reversible"] is True
+
+    with np.load(io.BytesIO(await resp.read())) as npz:
+        got = dict(npz)
+    hosts = [decode_to_coefficients(blobs[i]).to_host() for i in ids]
+    assert {_unkey(k) for k in got} == set(hosts[0])
+    for key, arr in got.items():
+        assert arr.shape[0] == len(ids)
+        np.testing.assert_array_equal(
+            arr, np.stack([h[_unkey(key)] for h in hosts]))
+
+
+async def test_post_batches_partial_failure(tmp_path, env_client,
+                                            monkeypatch):
+    ids, blobs = _write_batch_items(tmp_path, monkeypatch, n=3)
+    # Truncate one derivative mid-codestream: probe passes, Tier-1
+    # fails -> a typed manifest row, not an all-or-nothing error.
+    broken = ids[1]
+    with open(output_path(broken, ".jpx"), "wb") as fh:
+        fh.write(blobs[broken][:len(blobs[broken]) // 2])
+    client, _ = await env_client()
+    resp = await client.post("/batches", json={"ids": ids})
+    assert resp.status == 200
+    meta = json.loads(resp.headers["X-Batch-Meta"])
+    flags = {e["id"]: e["ok"] for e in meta["manifest"]}
+    assert flags == {ids[0]: True, broken: False, ids[2]: True}
+    assert meta["ids"] == [ids[0], ids[2]]
+    with np.load(io.BytesIO(await resp.read())) as npz:
+        for arr in npz.values():
+            assert arr.shape[0] == 2
+
+
+async def test_post_batches_store_and_get(tmp_path, env_client,
+                                          monkeypatch):
+    ids, _ = _write_batch_items(tmp_path, monkeypatch)
+    client, _ = await env_client()
+    resp = await client.post("/batches",
+                             json={"ids": ids, "store": True})
+    assert resp.status == 201
+    stats = await resp.json()
+    batch_id = stats["batch-id"]
+    assert stats["ids"] == ids
+    assert stats["n_bands"] > 0
+
+    # Full-fidelity npz read-back.
+    resp = await client.get(f"/batches/{batch_id}")
+    assert resp.status == 200
+    meta = json.loads(resp.headers["X-Batch-Meta"])
+    assert meta["ids"] == ids
+    full = await resp.read()
+    with np.load(io.BytesIO(full)) as npz:
+        full_bands = dict(npz)
+
+    # Progressive cut: fewer coded planes, same geometry.
+    resp = await client.get(f"/batches/{batch_id}?planes=1")
+    assert resp.status == 200
+    with np.load(io.BytesIO(await resp.read())) as npz:
+        for key, arr in npz.items():
+            assert arr.shape == full_bands[key].shape
+
+    # Raw (truncated) container.
+    resp = await client.get(f"/batches/{batch_id}?format=blob&planes=1")
+    assert resp.status == 200
+    assert resp.headers["X-Batch-Format"] == "btb1"
+    blob = await resp.read()
+    assert blob[:4] == b"BTB1"
+    resp2 = await client.get(f"/batches/{batch_id}?format=blob")
+    assert len(blob) < len(await resp2.read())
+
+
+async def test_post_batches_store_planes_floor(tmp_path, env_client,
+                                               monkeypatch):
+    ids, _ = _write_batch_items(tmp_path, monkeypatch)
+    client, _ = await env_client()
+    resp = await client.post(
+        "/batches", json={"ids": ids, "store": True, "planes": 1})
+    assert resp.status == 201
+    floored = await resp.json()
+    resp = await client.post("/batches",
+                             json={"ids": ids, "store": True})
+    full = await resp.json()
+    assert floored["coded_bytes"] < full["coded_bytes"]
+
+
+async def test_batches_typed_400s(tmp_path, env_client, monkeypatch):
+    ids, _ = _write_batch_items(tmp_path, monkeypatch)
+    client, _ = await env_client()
+
+    async def status(doc):
+        return (await client.post("/batches", json=doc)).status
+
+    # Malformed body: not JSON at all.
+    resp = await client.post("/batches", data=b"\x00not-json")
+    assert resp.status == 400
+    # Recipe-shaped garbage -> parse_recipe 400s.
+    assert await status({}) == 400
+    assert await status({"ids": []}) == 400
+    assert await status({"ids": ids, "bogus": 1}) == 400
+    assert await status({"ids": ids, "region": [0, 0, 0, 4]}) == 400
+    assert await status({"ids": ids, "dtype": "int8"}) == 400
+    assert await status({"ids": ids, "planes": 2}) == 400
+    # Past parsing: unknown ids, reduce beyond the coded levels,
+    # dtype mismatch — InvalidParam from the assembler, still 400.
+    assert await status({"ids": ["no-such-item"]}) == 400
+    assert await status({"ids": ids, "reduce": 5}) == 400
+    assert await status({"ids": ids, "dtype": "float32"}) == 400
+
+    # GET-side 400s and the 404.
+    assert (await client.get("/batches/x?format=xml")).status == 400
+    assert (await client.get("/batches/x?planes=zero")).status == 400
+    assert (await client.get("/batches/x?planes=0")).status == 400
+    assert (await client.get("/batches/no-such-batch")).status == 404
+
+
+async def test_batches_admission_503(tmp_path, env_client, monkeypatch):
+    """QueueFull surfaces as 503 + Retry-After on POST /batches, the
+    same ladder as every other admitted kind (forced via the
+    graftgremlin injection point)."""
+    from bucketeer_tpu.engine import faults
+    from bucketeer_tpu.engine.scheduler import QueueFull
+
+    ids, _ = _write_batch_items(tmp_path, monkeypatch)
+    client, _ = await env_client()
+    faults.install(faults.FaultPlan().at(
+        "sched.submit", lambda: QueueFull(1, 2.5, "batchread"),
+        times=1))
+    try:
+        resp = await client.post("/batches", json={"ids": ids})
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+    finally:
+        faults.install(None)

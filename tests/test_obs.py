@@ -276,6 +276,18 @@ def test_slo_parse_and_thresholds():
     assert obs.SloWatchdog.parse("750").threshold_ms("any") == 750
 
 
+def test_slo_camelcase_operation_ids():
+    """Operators write OpenAPI operationIds (``postBatches=800``); the
+    ``http.*`` stages are labeled with snake_case handler names. Both
+    spellings must find the same budget, whichever configured it."""
+    w = obs.SloWatchdog.parse("postBatches=800,get_batch=250")
+    assert w.threshold_ms("post_batches") == 800
+    assert w.threshold_ms("postBatches") == 800
+    assert w.threshold_ms("get_batch") == 250
+    assert w.threshold_ms("getBatch") == 250
+    assert w.report() == {"get_batch_ms": 250.0, "post_batches_ms": 800.0}
+
+
 def test_slo_breach_counts_and_dumps_flight(recorder):
     sink = Metrics()
     watchdog = obs.SloWatchdog.parse("getImage=10", sink=sink,
